@@ -1,0 +1,132 @@
+"""Unit tests for StreamArchive, FlatFAT, Iterable, LocalStorage, meta."""
+import random
+
+import pytest
+
+from windflow_tpu.core import (BasicRecord, FlatFAT, Iterable, LocalStorage,
+                               RuntimeContext, StreamArchive)
+from windflow_tpu.core.meta import arity, default_hash, is_rich, with_context
+
+
+def rec(tid, ts=None, val=0.0):
+    return BasicRecord(0, tid, ts if ts is not None else tid, val)
+
+
+class TestStreamArchive:
+    def test_ordered_insert(self):
+        a = StreamArchive(sort_key=lambda t: t.ts)
+        for ts in [5, 1, 3, 2, 4]:
+            a.insert(rec(ts, ts))
+        assert [t.ts for t in a.items()] == [1, 2, 3, 4, 5]
+
+    def test_win_range_and_purge(self):
+        a = StreamArchive(sort_key=lambda t: t.ts)
+        for ts in range(10):
+            a.insert(rec(ts, ts))
+        lo, hi = a.win_range(rec(0, 3), rec(0, 7))
+        assert [t.ts for t in a.slice(lo, hi)] == [3, 4, 5, 6]
+        assert a.distance(rec(0, 3), rec(0, 7)) == 4
+        purged = a.purge(rec(0, 4))
+        assert purged == 4
+        assert [t.ts for t in a.items()] == [4, 5, 6, 7, 8, 9]
+
+    def test_open_ended_range(self):
+        a = StreamArchive(sort_key=lambda t: t.ts)
+        for ts in range(5):
+            a.insert(rec(ts, ts))
+        lo, hi = a.win_range(rec(0, 2), None)
+        assert hi == len(a) and [t.ts for t in a.slice(lo, hi)] == [2, 3, 4]
+
+    def test_duplicate_keys_keep_arrival_order(self):
+        a = StreamArchive(sort_key=lambda t: t.ts)
+        r1, r2 = rec(1, 5, 1.0), rec(2, 5, 2.0)
+        a.insert(r1)
+        a.insert(r2)
+        assert a.items() == [r1, r2]
+
+
+class TestFlatFAT:
+    def test_sum_window(self):
+        f = FlatFAT(combine=lambda a, b: a + b, empty=lambda: 0, n_leaves=8)
+        f.insert_bulk([1, 2, 3, 4, 5])
+        assert f.get_result() == 15
+        f.remove(2)  # evict 1, 2
+        assert f.get_result() == 12
+        f.insert_bulk([10, 20])
+        assert f.get_result() == 42
+
+    def test_wraparound(self):
+        f = FlatFAT(combine=lambda a, b: a + b, empty=lambda: 0, n_leaves=4)
+        f.insert_bulk([1, 2, 3, 4])
+        f.remove(3)
+        f.insert_bulk([5, 6, 7])  # ring wraps
+        assert f.get_result() == 4 + 5 + 6 + 7
+
+    def test_non_commutative_order_preserved(self):
+        # combine = string concat: order must be oldest->newest even wrapped
+        f = FlatFAT(combine=lambda a, b: a + b, empty=lambda: "", n_leaves=4)
+        f.insert_bulk(["a", "b", "c", "d"])
+        assert f.get_result() == "abcd"
+        f.remove(2)
+        f.insert_bulk(["e", "f"])
+        assert f.get_result() == "cdef"
+        f.remove(3)
+        assert f.get_result() == "f"
+
+    def test_matches_naive_sliding_window(self):
+        rnd = random.Random(7)
+        f = FlatFAT(combine=lambda a, b: a + b, empty=lambda: 0, n_leaves=64)
+        window = []
+        for step in range(500):
+            v = rnd.randint(-100, 100)
+            f.insert(v)
+            window.append(v)
+            if len(window) > 50:
+                f.remove(1)
+                window.pop(0)
+            assert f.get_result() == sum(window)
+
+    def test_capacity_guard(self):
+        f = FlatFAT(combine=lambda a, b: a + b, empty=lambda: 0, n_leaves=2)
+        f.insert_bulk([1, 2])
+        with pytest.raises(OverflowError):
+            f.insert(3)
+
+
+class TestIterable:
+    def test_view(self):
+        items = [rec(i) for i in range(10)]
+        it = Iterable(items, 2, 6)
+        assert len(it) == 4
+        assert it[0].id == 2 and it.at(3).id == 5
+        assert [t.id for t in it] == [2, 3, 4, 5]
+        with pytest.raises(IndexError):
+            it[4]
+
+
+class TestContextMeta:
+    def test_local_storage_default_construct(self):
+        s = LocalStorage()
+        v = s.get("acc", factory=lambda: [])
+        v.append(1)
+        assert s.get("acc") == [1]
+        s.remove("acc")
+        assert not s.is_contained("acc")
+
+    def test_arity_and_rich(self):
+        assert arity(lambda t: t) == 1
+        assert arity(lambda t, c: t) == 2
+        assert not is_rich(lambda t: t, 1)
+        assert is_rich(lambda t, ctx: t, 1)
+        with pytest.raises(TypeError):
+            is_rich(lambda a, b, c: None, 1)
+
+    def test_with_context_binds(self):
+        ctx = RuntimeContext(4, 2)
+        fn = with_context(lambda t, c: (t, c.get_replica_index()), 1, ctx)
+        assert fn(5) == (5, 2)
+
+    def test_default_hash_stable(self):
+        assert default_hash(42) == 42
+        assert default_hash("abc") == default_hash("abc")
+        assert default_hash("abc") != default_hash("abd")

@@ -1,0 +1,159 @@
+"""Unit tests for triggerers, Window, and window-assignment arithmetic.
+
+The reference has no unit tests for these (SURVEY.md §4); windflow_tpu
+tests them directly since the determinism oracles hinge on this math.
+"""
+import numpy as np
+import pytest
+
+from windflow_tpu.core import (BasicRecord, TriggererCB, TriggererTB, Window,
+                               WinEvent, WinType, WinOperatorConfig, Role)
+from windflow_tpu.core.window import classify_cb, classify_tb
+from windflow_tpu.core import win_assign as wa
+
+
+class TestTriggererCB:
+    def test_boundaries(self):
+        # window lwid=2 of win=5 slide=3 initial=0 spans ids [6, 11)
+        t = TriggererCB(win_len=5, slide_len=3, lwid=2, initial_id=0)
+        assert t(5) == WinEvent.OLD
+        assert t(6) == WinEvent.IN
+        assert t(10) == WinEvent.IN
+        assert t(11) == WinEvent.FIRED
+
+    def test_initial_offset(self):
+        t = TriggererCB(win_len=4, slide_len=4, lwid=0, initial_id=100)
+        assert t(99) == WinEvent.OLD
+        assert t(100) == WinEvent.IN
+        assert t(103) == WinEvent.IN
+        assert t(104) == WinEvent.FIRED
+
+    def test_vectorized_matches_scalar(self):
+        t = TriggererCB(win_len=5, slide_len=3, lwid=4, initial_id=7)
+        ids = np.arange(0, 60, dtype=np.int64)
+        vec = classify_cb(ids, 5, 3, 4, 7)
+        for i, tid in enumerate(ids):
+            assert vec[i] == t(int(tid)).value
+
+
+class TestTriggererTB:
+    def test_boundaries_with_delay(self):
+        # lwid=1, win=10, slide=5, start=0, delay=3 -> extent [5,15), delayed [15,18)
+        t = TriggererTB(win_len=10, slide_len=5, lwid=1, starting_ts=0,
+                        triggering_delay=3)
+        assert t(4) == WinEvent.OLD
+        assert t(5) == WinEvent.IN
+        assert t(14) == WinEvent.IN
+        assert t(15) == WinEvent.DELAYED
+        assert t(17) == WinEvent.DELAYED
+        assert t(18) == WinEvent.FIRED
+
+    def test_no_delay(self):
+        t = TriggererTB(win_len=10, slide_len=10, lwid=0, starting_ts=50)
+        assert t(49) == WinEvent.OLD
+        assert t(59) == WinEvent.IN
+        assert t(60) == WinEvent.FIRED
+
+    def test_vectorized_matches_scalar(self):
+        t = TriggererTB(win_len=9, slide_len=4, lwid=3, starting_ts=2,
+                        triggering_delay=5)
+        ts = np.arange(0, 80, dtype=np.int64)
+        vec = classify_tb(ts, 9, 4, 3, 2, 5)
+        for i, x in enumerate(ts):
+            assert vec[i] == t(int(x)).value
+
+
+class TestWindow:
+    def _win(self, wtype, win_len=4, slide=4, lwid=0, gwid=0):
+        trig = (TriggererCB(win_len, slide, lwid, 0) if wtype == WinType.CB
+                else TriggererTB(win_len, slide, lwid, 0, 0))
+        w = Window(key=1, lwid=lwid, gwid=gwid, triggerer=trig,
+                   win_type=wtype, win_len=win_len, slide_len=slide)
+        w.init_result(BasicRecord())
+        return w
+
+    def test_cb_result_control_fields(self):
+        w = self._win(WinType.CB)
+        k, g, ts = w.result.get_control_fields()
+        assert (k, g, ts) == (1, 0, 0)
+
+    def test_tb_result_ts_is_window_end(self):
+        w = self._win(WinType.TB, win_len=10, slide=5, gwid=3)
+        _, _, ts = w.result.get_control_fields()
+        assert ts == 3 * 5 + 10 - 1
+
+    def test_cb_lifecycle(self):
+        w = self._win(WinType.CB, win_len=3, slide=3)
+        for i in range(3):
+            assert w.on_tuple(BasicRecord(1, i, 100 + i)) == WinEvent.IN
+        assert w.no_tuples == 3
+        # result ts tracks most recent IN tuple
+        assert w.result.get_control_fields()[2] == 102
+        assert w.on_tuple(BasicRecord(1, 3, 103)) == WinEvent.FIRED
+        assert w.last_tuple.id == 3
+
+    def test_tb_first_tuple_is_oldest(self):
+        w = self._win(WinType.TB, win_len=10, slide=10)
+        w.on_tuple(BasicRecord(1, 0, 5))
+        w.on_tuple(BasicRecord(1, 1, 2))  # out of order, older
+        assert w.first_tuple.ts == 2
+        assert w.on_tuple(BasicRecord(1, 2, 11)) == WinEvent.FIRED
+        w.on_tuple(BasicRecord(1, 3, 9))  # IN again (out of order)
+        assert w.no_tuples == 3
+
+    def test_batched_short_circuit(self):
+        w = self._win(WinType.CB)
+        w.set_batched()
+        assert w.on_tuple(BasicRecord(1, 0, 0)) == WinEvent.BATCHED
+
+
+class TestWinAssign:
+    def test_single_replica_identity(self):
+        cfg = WinOperatorConfig(0, 1, 0, 0, 1, 0)
+        assert wa.first_gwid_of_key(12345, cfg) == 0
+        assert wa.initial_id_of_key(12345, cfg, Role.SEQ) == 0
+        assert wa.gwid_of_lwid(0, 7, cfg) == 7
+
+    def test_outer_farm_partition(self):
+        # Win_Farm with 4 workers, slide 3: worker w owns every 4th window
+        # of each key, starting at window (w - hash) mod 4.
+        n, slide = 4, 3
+        for hashcode in (0, 1, 5, 11):
+            owners = {}
+            for wid in range(0, 16):
+                # window wid of this key belongs to worker (hash + wid) % n
+                owners.setdefault((hashcode % n + wid) % n, []).append(wid)
+            for worker in range(n):
+                cfg = WinOperatorConfig(worker, n, slide, 0, 1, 0)
+                fg = wa.first_gwid_of_key(hashcode, cfg)
+                got = [wa.gwid_of_lwid(fg, l, cfg) for l in range(4)]
+                assert got == owners[worker][:4]
+                # initial id skips the windows of earlier workers
+                assert wa.initial_id_of_key(hashcode, cfg, Role.SEQ) == \
+                    ((worker - hashcode % n + n) % n) * slide
+
+    def test_window_range_sliding(self):
+        # win=6 slide=2: tuple id 7 is in windows starting at 2,4,6 -> lwids 1,2,3
+        fw, lw = wa.window_range_of(7, 0, 6, 2)
+        assert (fw, lw) == (1, 3)
+        assert wa.last_window_of(7, 0, 6, 2) == 3
+
+    def test_window_range_tumbling(self):
+        fw, lw = wa.window_range_of(9, 0, 5, 5)
+        assert (fw, lw) == (1, 1)
+
+    def test_window_range_hopping_gap(self):
+        # win=2 slide=5: ids 2,3,4 fall in gaps
+        assert wa.window_range_of(3, 0, 2, 5) == (-1, -1)
+        assert wa.last_window_of(3, 0, 2, 5) == -1
+        assert wa.window_range_of(5, 0, 2, 5) == (1, 1)
+
+    def test_wf_destinations_caps_at_pardegree(self):
+        dests = wa.wf_destinations(hashcode=2, first_w=0, last_w=9, pardegree=4)
+        assert len(dests) == 4 and sorted(dests) == [0, 1, 2, 3]
+        assert dests[0] == 2  # first window of key at hash % pardegree
+
+    def test_pane_length(self):
+        assert wa.pane_length(12, 8) == 4
+        assert wa.pane_length(10, 5) == 5
+        assert wa.pane_length(7, 3) == 1

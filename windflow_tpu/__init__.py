@@ -1,0 +1,56 @@
+"""windflow_tpu: a TPU-native data-stream-processing framework.
+
+Brand-new design with the capabilities of the reference C++/CUDA
+library (see SURVEY.md): PipeGraph/MultiPipe graphs of streaming
+operators -- map/filter/flatmap/accumulate/sink plus the full family of
+parallel sliding-window operators (Win_Seq, Win_Farm, Key_Farm,
+Pane_Farm, Win_MapReduce, FlatFAT-based FFAT variants) -- where batched
+window computation lowers to XLA/Pallas programs and multi-chip scaling
+uses jax.sharding over a TPU mesh instead of CUDA kernels.
+
+Public surface (umbrella import, the analogue of windflow.hpp:33-50 /
+windflow_gpu.hpp:34-42):
+
+    import windflow_tpu as wf
+    g = wf.PipeGraph("app", wf.Mode.DEFAULT)
+    src = wf.SourceBuilder(gen).with_parallelism(2).build()
+    ...
+"""
+from .core import (Mode, WinType, OptLevel, RoutingMode, Pattern, WinEvent,
+                   OrderingMode, Role, WinOperatorConfig, RuntimeConfig,
+                   BasicRecord, TupleBatch, EOS, TriggererCB, TriggererTB,
+                   Window, StreamArchive, FlatFAT, Iterable, Shipper,
+                   RuntimeContext, LocalStorage)
+
+__version__ = "0.1.0"
+
+# Graph / operator / builder layers are imported lazily below as they are
+# built up; keeping this umbrella import cheap (no jax import at package
+# import time -- device code loads on first use).
+
+
+def __getattr__(name):
+    # Lazy exports: graph + builders (host plane) and TPU builders.
+    from importlib import import_module
+    lazy = {
+        "PipeGraph": "windflow_tpu.graph.pipegraph",
+        "MultiPipe": "windflow_tpu.graph.multipipe",
+    }
+    builder_names = (
+        "SourceBuilder", "FilterBuilder", "MapBuilder", "FlatMapBuilder",
+        "AccumulatorBuilder", "SinkBuilder", "WinSeqBuilder",
+        "WinFarmBuilder", "KeyFarmBuilder", "PaneFarmBuilder",
+        "WinMapReduceBuilder", "WinSeqFFATBuilder", "KeyFFATBuilder",
+    )
+    tpu_builder_names = (
+        "WinSeqTPUBuilder", "WinFarmTPUBuilder", "KeyFarmTPUBuilder",
+        "PaneFarmTPUBuilder", "WinMapReduceTPUBuilder",
+        "WinSeqFFATTPUBuilder", "KeyFFATTPUBuilder",
+    )
+    if name in lazy:
+        return getattr(import_module(lazy[name]), name)
+    if name in builder_names:
+        return getattr(import_module("windflow_tpu.builders.builders"), name)
+    if name in tpu_builder_names:
+        return getattr(import_module("windflow_tpu.builders.builders_tpu"), name)
+    raise AttributeError(f"module 'windflow_tpu' has no attribute {name!r}")

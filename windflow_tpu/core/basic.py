@@ -1,0 +1,157 @@
+"""Core enums, constants and configuration for windflow_tpu.
+
+TPU-native re-design of the reference's ``wf/basic.hpp`` (enums at
+basic.hpp:86-135, WinOperatorConfig at basic.hpp:154-184, GPU batching
+defaults at basic.hpp:77-80).  Everything the reference spreads over
+compile-time macros + builder parameters is folded into one runtime
+config surface here (SURVEY.md §5 "Config / flag system").
+"""
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass
+
+
+class Mode(enum.Enum):
+    """Execution modes of a PipeGraph (reference basic.hpp:86).
+
+    DEFAULT        -- streams assumed ordered per source; no reordering plane.
+    DETERMINISTIC  -- ordering collectors (watermark-by-min priority queues)
+                      inserted before every operator (ref ordering_node.hpp).
+    PROBABILISTIC  -- K-slack collectors; late tuples may be dropped
+                      (ref kslack_node.hpp).
+    """
+
+    DEFAULT = 0
+    DETERMINISTIC = 1
+    PROBABILISTIC = 2
+
+
+class WinType(enum.Enum):
+    """Window model (reference basic.hpp:89): count-based or time-based."""
+
+    CB = 0
+    TB = 1
+
+
+class OptLevel(enum.IntEnum):
+    """Optimization levels for composite window operators (basic.hpp:92)."""
+
+    LEVEL0 = 0  # no optimization
+    LEVEL1 = 1  # strip internal collectors where ordering is not required
+    LEVEL2 = 2  # fuse distribution via tree emitters / stage fusion
+
+
+class RoutingMode(enum.Enum):
+    """How an operator receives its inputs (basic.hpp:95)."""
+
+    NONE = 0
+    FORWARD = 1
+    KEYBY = 2
+    COMPLEX = 3
+
+
+class Pattern(enum.Enum):
+    """Operator kinds (basic.hpp:98-123); used for diagnostics/diagrams."""
+
+    SOURCE = 0
+    FILTER = 1
+    MAP = 2
+    FLATMAP = 3
+    ACCUMULATOR = 4
+    SINK = 5
+    WIN_SEQ = 6
+    WIN_FARM = 7
+    KEY_FARM = 8
+    PANE_FARM = 9
+    WIN_MAPREDUCE = 10
+    WIN_SEQFFAT = 11
+    KEY_FFAT = 12
+    WIN_SEQ_TPU = 13
+    WIN_FARM_TPU = 14
+    KEY_FARM_TPU = 15
+    PANE_FARM_TPU = 16
+    WIN_MAPREDUCE_TPU = 17
+    WIN_SEQFFAT_TPU = 18
+    KEY_FFAT_TPU = 19
+
+
+class WinEvent(enum.Enum):
+    """Events raised by a window on a new tuple (basic.hpp:126)."""
+
+    OLD = 0       # tuple precedes the window extent
+    IN = 1        # tuple belongs to the window
+    DELAYED = 2   # TB only: past the extent but within the triggering delay
+    FIRED = 3     # tuple proves the window complete
+    BATCHED = 4   # window already handed to a device batch
+
+
+class OrderingMode(enum.Enum):
+    """What field the ordering collector sorts on (basic.hpp:129)."""
+
+    ID = 0
+    TS = 1
+    TS_RENUMBERING = 2
+
+
+class Role(enum.Enum):
+    """Role of a windowed engine inside a composite operator (basic.hpp:132)."""
+
+    SEQ = 0
+    PLQ = 1
+    WLQ = 2
+    MAP = 3
+    REDUCE = 4
+
+
+# Defaults mirroring reference basic.hpp:74-83, re-targeted at TPU batching.
+DEFAULT_BATCH_SIZE_TB = 1000      # initial device batch for TB windows
+DEFAULT_UPDATE_INTERVAL_USEC = 100_000
+DEFAULT_QUEUE_CAPACITY = 2048     # bounded SPSC queue capacity (backpressure)
+DEFAULT_MICROBATCH = 256          # host-plane micro-batch (tuples per queue item)
+
+
+def current_time_usecs() -> int:
+    """Monotonic microseconds (reference basic.hpp:51-71 clock helpers)."""
+    return time.monotonic_ns() // 1000
+
+
+def current_time_nsecs() -> int:
+    return time.monotonic_ns()
+
+
+@dataclass
+class WinOperatorConfig:
+    """Distributed window-id assignment parameters (basic.hpp:154-184).
+
+    A windowed engine replica inside a composite operator learns which
+    global windows it owns from (id, n, slide) pairs at two nesting
+    levels ("outer" = the enclosing farm, "inner" = the stage inside).
+    The gwid/initial-id arithmetic consuming these lives in
+    ``core.win_assign`` (reference win_seq.hpp:348-357).
+    """
+
+    id_outer: int = 0
+    n_outer: int = 1
+    slide_outer: int = 0
+    id_inner: int = 0
+    n_inner: int = 1
+    slide_inner: int = 0
+
+
+@dataclass
+class RuntimeConfig:
+    """Global runtime knobs (folds the reference's macro set: README
+    "Macros" -- TRACE_WINDFLOW, FF_BOUNDED_BUFFER, DEFAULT_BUFFER_CAPACITY,
+    BLOCKING_MODE, NO_DEFAULT_MAPPING, DASHBOARD_MACHINE/PORT, LOG_DIR)."""
+
+    mode: Mode = Mode.DEFAULT
+    tracing: bool = False
+    bounded_queues: bool = True
+    queue_capacity: int = DEFAULT_QUEUE_CAPACITY
+    microbatch: int = DEFAULT_MICROBATCH
+    dashboard_machine: str = "localhost"
+    dashboard_port: int = 20207
+    log_dir: str = "log"
+    use_native_runtime: bool = True   # prefer the C++ host runtime when built

@@ -1,0 +1,172 @@
+"""Tuple contract and the columnar batch type.
+
+The reference imposes a structural contract on user types:
+``getControlFields() -> (key, id, ts)`` / ``setControlFields(key,id,ts)``
+(used e.g. at win_seq.hpp:331-333; test type mp_tests_gpu/mp_common.hpp:44-81).
+We keep that contract for the record-oriented plane and add the thing the
+reference cannot have: a **columnar TupleBatch** -- the native currency of
+the TPU plane.  A stream here is a sequence of batches (struct-of-arrays),
+which is what XLA wants; single records exist only at the API edge.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Protocol, Tuple, runtime_checkable
+
+import numpy as np
+
+
+@runtime_checkable
+class WFRecord(Protocol):
+    """Structural contract every user record type must satisfy."""
+
+    def get_control_fields(self) -> Tuple[Any, int, int]:
+        """Return (key, id, ts)."""
+        ...
+
+    def set_control_fields(self, key: Any, tid: int, ts: int) -> None:
+        ...
+
+
+class BasicRecord:
+    """Convenience record: key/id/ts control fields + a float value.
+
+    Mirrors the reference test fixture tuple (mp_common.hpp:44-81) but is
+    a library type so users do not have to define one for simple streams.
+    """
+
+    __slots__ = ("key", "id", "ts", "value")
+
+    def __init__(self, key: Any = 0, tid: int = 0, ts: int = 0, value: float = 0.0):
+        self.key = key
+        self.id = tid
+        self.ts = ts
+        self.value = value
+
+    def get_control_fields(self):
+        return (self.key, self.id, self.ts)
+
+    def set_control_fields(self, key, tid, ts):
+        self.key = key
+        self.id = tid
+        self.ts = ts
+
+    def __repr__(self):
+        return f"BasicRecord(key={self.key}, id={self.id}, ts={self.ts}, value={self.value})"
+
+
+class TupleBatch:
+    """Columnar micro-batch of tuples: dict of equal-length numpy columns.
+
+    Required columns: ``key`` (int64), ``id`` (int64), ``ts`` (int64).
+    Any number of payload columns (e.g. ``value``).  This is the unit that
+    flows over host queues on the batch plane and the host-side staging
+    format for device transfers (the TPU analogue of the reference's
+    pinned-buffer batch assembly, win_seq_gpu.hpp:552-596).
+    """
+
+    __slots__ = ("cols",)
+
+    CONTROL = ("key", "id", "ts")
+
+    def __init__(self, cols: Dict[str, np.ndarray]):
+        for c in self.CONTROL:
+            if c not in cols:
+                raise ValueError(f"TupleBatch missing control column '{c}'")
+        n = len(cols["key"])
+        for name, col in cols.items():
+            if len(col) != n:
+                raise ValueError(f"column '{name}' length {len(col)} != {n}")
+        self.cols = cols
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_records(cls, records, payload=("value",)) -> "TupleBatch":
+        keys, ids, tss = [], [], []
+        pay = {p: [] for p in payload}
+        for r in records:
+            k, i, t = r.get_control_fields()
+            keys.append(k)
+            ids.append(i)
+            tss.append(t)
+            for p in payload:
+                pay[p].append(getattr(r, p))
+        cols = {
+            "key": np.asarray(keys, dtype=np.int64),
+            "id": np.asarray(ids, dtype=np.int64),
+            "ts": np.asarray(tss, dtype=np.int64),
+        }
+        for p in payload:
+            cols[p] = np.asarray(pay[p])
+        return cls(cols)
+
+    @classmethod
+    def empty_like(cls, other: "TupleBatch") -> "TupleBatch":
+        return cls({k: v[:0] for k, v in other.cols.items()})
+
+    # -- accessors ---------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.cols["key"])
+
+    @property
+    def key(self) -> np.ndarray:
+        return self.cols["key"]
+
+    @property
+    def id(self) -> np.ndarray:
+        return self.cols["id"]
+
+    @property
+    def ts(self) -> np.ndarray:
+        return self.cols["ts"]
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self.cols[name]
+
+    def payload_names(self):
+        return [c for c in self.cols if c not in self.CONTROL]
+
+    # -- transforms --------------------------------------------------------
+    def take(self, idx) -> "TupleBatch":
+        return TupleBatch({k: v[idx] for k, v in self.cols.items()})
+
+    def concat(self, other: "TupleBatch") -> "TupleBatch":
+        return TupleBatch(
+            {k: np.concatenate([v, other.cols[k]]) for k, v in self.cols.items()}
+        )
+
+    def with_cols(self, **cols) -> "TupleBatch":
+        out = dict(self.cols)
+        out.update(cols)
+        return TupleBatch(out)
+
+    def records(self, cls=BasicRecord) -> Iterator[Any]:
+        """Materialize records at the API edge (slow path, tests only)."""
+        names = self.payload_names()
+        for i in range(len(self)):
+            r = cls(self.cols["key"][i].item(), self.cols["id"][i].item(),
+                    self.cols["ts"][i].item())
+            for p in names:
+                if hasattr(r, p):
+                    setattr(r, p, self.cols[p][i].item())
+            yield r
+
+    def __repr__(self):
+        return f"TupleBatch(n={len(self)}, cols={list(self.cols)})"
+
+
+class EOS:
+    """End-of-stream marker carried over host queues.
+
+    The reference encodes EOS as a flagged refcounted wrapper
+    (meta.hpp:770-783, ``isEOSMarker``); here it is a first-class queue
+    item optionally carrying the per-key last tuples a WF emitter needs
+    to broadcast (wf_nodes.hpp:207-227).
+    """
+
+    __slots__ = ("payload",)
+
+    def __init__(self, payload=None):
+        self.payload = payload
+
+    def __repr__(self):
+        return "EOS()"
