@@ -1,0 +1,202 @@
+"""Fluent builders: the user-facing construction API.
+
+Re-design of reference ``wf/builders.hpp`` (13 CPU builders, :49-2357).
+Method surface kept: withName / withParallelism / withCBWindows /
+withTBWindows(len, slide[, delay]) / withClosingFunction /
+withInitialValue / withOptLevel / build.  Both snake_case and the
+reference's camelCase spellings are provided so users of the reference
+can port code mechanically.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..core.basic import OptLevel, WinType
+from ..core.tuples import BasicRecord
+from ..operators.basic_ops import (Accumulator, Filter, FlatMap, Map, Sink,
+                                   Source)
+from ..operators.win_seq import WinSeq
+
+
+def _alias_camel(cls):
+    """Attach camelCase aliases for every with_/build method."""
+    for name in list(vars(cls)):
+        if name.startswith("with_") or name in ("build_ptr",):
+            parts = name.split("_")
+            camel = parts[0] + "".join(p.upper() if p in ("cb", "tb")
+                                       else p.capitalize()
+                                       for p in parts[1:])
+            setattr(cls, camel, vars(cls)[name])
+    return cls
+
+
+class _BuilderBase:
+    _default_name = "op"
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.name = self._default_name
+        self.parallelism = 1
+        self.closing_func = None
+
+    def with_name(self, name: str):
+        self.name = name
+        return self
+
+    def with_parallelism(self, n: int):
+        self.parallelism = n
+        return self
+
+    def with_closing_function(self, fn: Callable):
+        self.closing_func = fn
+        return self
+
+    def build_ptr(self):
+        return self.build()
+
+
+class _WinBuilderBase(_BuilderBase):
+    """Shared window-spec surface (builders.hpp:851-858 and peers)."""
+
+    def __init__(self, fn):
+        super().__init__(fn)
+        self.win_len = None
+        self.slide_len = None
+        self.win_type = None
+        self.triggering_delay = 0
+        self.opt_level = OptLevel.LEVEL0
+        self.result_factory = BasicRecord
+        self.incremental = False
+
+    def with_cb_windows(self, win_len: int, slide_len: int):
+        self.win_type = WinType.CB
+        self.win_len = win_len
+        self.slide_len = slide_len
+        return self
+
+    def with_tb_windows(self, win_len_us: int, slide_len_us: int,
+                        triggering_delay_us: int = 0):
+        self.win_type = WinType.TB
+        self.win_len = win_len_us
+        self.slide_len = slide_len_us
+        self.triggering_delay = triggering_delay_us
+        return self
+
+    def with_opt_level(self, level: OptLevel):
+        self.opt_level = OptLevel(level)
+        return self
+
+    def with_result_type(self, factory: Callable[[], Any]):
+        self.result_factory = factory
+        return self
+
+    def with_incremental(self, incremental: bool = True):
+        """Select the incremental (winupdate) query style; the reference
+        dispatches on the callable's C++ signature (meta.hpp), Python
+        cannot, so it is explicit here."""
+        self.incremental = incremental
+        return self
+
+    def _check_windows(self):
+        if self.win_type is None:
+            raise ValueError(
+                f"{type(self).__name__}: call with_cb_windows or "
+                "with_tb_windows before build()")
+
+
+@_alias_camel
+class SourceBuilder(_BuilderBase):
+    _default_name = "source"
+
+    def build(self) -> Source:
+        return Source(self.fn, self.parallelism, self.name,
+                      self.closing_func)
+
+
+@_alias_camel
+class FilterBuilder(_BuilderBase):
+    _default_name = "filter"
+
+    def __init__(self, fn):
+        super().__init__(fn)
+        self.keyed = False
+
+    def with_key_by(self):
+        self.keyed = True
+        return self
+
+    def build(self) -> Filter:
+        return Filter(self.fn, self.parallelism, self.name,
+                      self.closing_func, self.keyed)
+
+
+@_alias_camel
+class MapBuilder(_BuilderBase):
+    _default_name = "map"
+
+    def __init__(self, fn):
+        super().__init__(fn)
+        self.keyed = False
+
+    def with_key_by(self):
+        self.keyed = True
+        return self
+
+    def build(self) -> Map:
+        return Map(self.fn, self.parallelism, self.name, self.closing_func,
+                   self.keyed)
+
+
+@_alias_camel
+class FlatMapBuilder(_BuilderBase):
+    _default_name = "flatmap"
+
+    def __init__(self, fn):
+        super().__init__(fn)
+        self.keyed = False
+
+    def with_key_by(self):
+        self.keyed = True
+        return self
+
+    def build(self) -> FlatMap:
+        return FlatMap(self.fn, self.parallelism, self.name,
+                       self.closing_func, self.keyed)
+
+
+@_alias_camel
+class AccumulatorBuilder(_BuilderBase):
+    _default_name = "accumulator"
+
+    def __init__(self, fn):
+        super().__init__(fn)
+        self.init_value = None
+
+    def with_initial_value(self, value: Any):
+        self.init_value = value
+        return self
+
+    def build(self) -> Accumulator:
+        if self.init_value is None:
+            self.init_value = BasicRecord()
+        return Accumulator(self.fn, self.init_value, self.parallelism,
+                           self.name, self.closing_func)
+
+
+@_alias_camel
+class SinkBuilder(_BuilderBase):
+    _default_name = "sink"
+
+    def build(self) -> Sink:
+        return Sink(self.fn, self.parallelism, self.name, self.closing_func)
+
+
+@_alias_camel
+class WinSeqBuilder(_WinBuilderBase):
+    _default_name = "win_seq"
+
+    def build(self) -> WinSeq:
+        self._check_windows()
+        return WinSeq(self.fn, self.win_len, self.slide_len, self.win_type,
+                      self.triggering_delay, self.incremental, self.name,
+                      self.result_factory, self.closing_func)

@@ -1,0 +1,218 @@
+"""MultiPipe: a linear (then split/merged) pipeline of operators.
+
+Re-design of reference ``wf/multipipe.hpp`` (2587 LoC).  Where the
+reference nests ff_a2a "matrioska" structures (multipipe.hpp:236-341),
+windflow_tpu wires an explicit flat graph of RtNode threads and
+channels: per-replica inbound collectors in DETERMINISTIC/PROBABILISTIC
+modes (multipipe.hpp:697-705), emitter clones per upstream producer,
+farm-level collectors after ordered window farms, and thread-fusion
+``chain`` for FORWARD operators (multipipe.hpp:345-390).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+from ..core.basic import Mode, OrderingMode, RoutingMode, WinType
+from ..operators.base import Operator, StageSpec
+from ..runtime.emitters import SplittingEmitter, StandardEmitter
+from ..runtime.node import NodeLogic, Outlet, RtNode
+from ..runtime.ordering import KSlackLogic, OrderingLogic
+from ..runtime.queues import Channel
+
+
+class ChainedLogic(NodeLogic):
+    """Thread fusion of two logics: b consumes a's emissions inline
+    (the reference's combine_with_laststage, multipipe.hpp:381)."""
+
+    def __init__(self, a: NodeLogic, b: NodeLogic):
+        self.a = a
+        self.b = b
+
+    def svc_init(self):
+        self.a.svc_init()
+        self.b.svc_init()
+
+    def svc(self, item, channel_id, emit):
+        self.a.svc(item, channel_id,
+                   lambda x: self.b.svc(x, 0, emit))
+
+    def eos_flush(self, emit):
+        self.a.eos_flush(lambda x: self.b.svc(x, 0, emit))
+        self.b.eos_flush(emit)
+
+    def svc_end(self):
+        self.a.svc_end()
+        self.b.svc_end()
+
+
+class MultiPipe:
+    def __init__(self, graph, name: str):
+        self.graph = graph
+        self.name = name
+        self.nodes: List[RtNode] = []   # every thread of this pipe
+        self.tails: List[RtNode] = []   # nodes whose outputs are unbound
+        self.has_source = False
+        self.has_sink = False
+        self.children: List["MultiPipe"] = []  # after split
+        self.merged_into: Optional[MultiPipe] = None
+        self._op_names: List[str] = []
+
+    # -- internal wiring ---------------------------------------------------
+    def _check_open(self):
+        if self.has_sink:
+            raise RuntimeError(f"MultiPipe {self.name}: already terminated "
+                               "by a sink")
+        if self.children:
+            raise RuntimeError(f"MultiPipe {self.name}: already split; use "
+                               "select()")
+        if self.merged_into is not None:
+            raise RuntimeError(f"MultiPipe {self.name}: already merged")
+        if not self.has_source:
+            raise RuntimeError(f"MultiPipe {self.name}: add a source first")
+
+    def _mark_used(self, op: Operator):
+        if op.used:
+            raise RuntimeError(f"operator {op.name} already used in a graph")
+        op.used = True
+
+    def _collector_for(self, ordering_mode: Optional[OrderingMode],
+                       n_channels: int, win_type: Optional[WinType] = None):
+        """Mode-dependent inbound collector (multipipe.hpp:697-705)."""
+        mode = self.graph.mode
+        if mode == Mode.DEFAULT or ordering_mode is None:
+            return None
+        if mode == Mode.DETERMINISTIC:
+            return OrderingLogic(ordering_mode, n_channels)
+        # PROBABILISTIC: K-slack; CB windows additionally need dense ids
+        km = (OrderingMode.TS_RENUMBERING
+              if ordering_mode == OrderingMode.ID else OrderingMode.TS)
+        return KSlackLogic(km, on_drop=self.graph._count_dropped)
+    def _append_stage(self, stage: StageSpec,
+                      win_type: Optional[WinType] = None):
+        n = len(stage.replicas)
+        cap = self.graph.config.queue_capacity
+        # per-replica inbound channel (collector front-end when required)
+        collector_logics = [
+            self._collector_for(stage.ordering_mode, len(self.tails), win_type)
+            for _ in range(n)]
+        entry_channels: List[Channel] = [Channel(cap) for _ in range(n)]
+        # emitter clone per upstream producer (reference: emitter combined
+        # into each tail node, multipipe.hpp:302-338)
+        for tail in self.tails:
+            em = stage.emitter_proto.clone()
+            em.set_n_destinations(n)
+            dests = [(ch, ch.register_producer()) for ch in entry_channels]
+            tail.outlets.append(Outlet(em, dests))
+        new_nodes: List[RtNode] = []
+        replica_nodes: List[RtNode] = []
+        for i, logic in enumerate(stage.replicas):
+            if collector_logics[i] is not None:
+                rep_ch = Channel(cap)
+                coll_node = RtNode(
+                    f"{self.name}/{stage.name}.coll{i}", collector_logics[i],
+                    entry_channels[i], [])
+                fwd = StandardEmitter()
+                fwd.set_n_destinations(1)
+                coll_node.outlets.append(
+                    Outlet(fwd, [(rep_ch, rep_ch.register_producer())]))
+                new_nodes.append(coll_node)
+                in_ch = rep_ch
+            else:
+                in_ch = entry_channels[i]
+            node = RtNode(f"{self.name}/{stage.name}.{i}", logic, in_ch, [])
+            new_nodes.append(node)
+            replica_nodes.append(node)
+        if stage.collector is not None:
+            cch = Channel(cap)
+            cnode = RtNode(f"{self.name}/{stage.name}.collector",
+                           stage.collector, cch, [])
+            for rn in replica_nodes:
+                fwd = StandardEmitter()
+                fwd.set_n_destinations(1)
+                rn.outlets.append(Outlet(fwd, [(cch, cch.register_producer())]))
+            new_nodes.append(cnode)
+            self.tails = [cnode]
+        else:
+            self.tails = replica_nodes
+        self.nodes.extend(new_nodes)
+        self._op_names.append(stage.name)
+
+    # -- public API (multipipe.hpp add/chain surface) ----------------------
+    def add_source(self, source: Operator) -> "MultiPipe":
+        if self.has_source:
+            raise RuntimeError("source already present")
+        self._mark_used(source)
+        stage = source.stages()[0]
+        for logic in stage.replicas:
+            node = RtNode(f"{self.name}/{stage.name}", logic, None, [])
+            self.nodes.append(node)
+            self.tails.append(node)
+        self.has_source = True
+        self._op_names.append(stage.name)
+        return self
+
+    def add(self, op: Operator) -> "MultiPipe":
+        self._check_open()
+        self._mark_used(op)
+        win_type = getattr(op, "win_type", None)
+        # CB windows in DEFAULT mode: renumber ids on arrival
+        # (win_seq.hpp:342-347 via multipipe wiring)
+        if (self.graph.mode == Mode.DEFAULT and win_type == WinType.CB
+                and hasattr(op, "enable_renumbering")):
+            op.enable_renumbering()
+        for stage in op.stages():
+            self._append_stage(stage, win_type)
+        return self
+
+    def chain(self, op: Operator) -> "MultiPipe":
+        """Thread-fuse a FORWARD operator into the current tail nodes when
+        parallelism matches; falls back to add() otherwise
+        (multipipe.hpp:345-390; chain exists only for Filter/Map/
+        FlatMap/Sink)."""
+        self._check_open()
+        logics = op.chain_logics()
+        if (logics is None or len(logics) != len(self.tails)
+                or self.graph.mode != Mode.DEFAULT):
+            return self.add(op)
+        self._mark_used(op)
+        for tail, logic in zip(self.tails, logics):
+            tail.logic = ChainedLogic(tail.logic, logic)
+        self._op_names.append(f"{op.name}(chained)")
+        return self
+
+    def add_sink(self, sink: Operator) -> "MultiPipe":
+        self.add(sink)
+        self.has_sink = True
+        return self
+
+    def chain_sink(self, sink: Operator) -> "MultiPipe":
+        self.chain(sink)
+        self.has_sink = True
+        return self
+
+    # -- split / merge (pipegraph executes; multipipe.hpp:2478-2583) -------
+    def split(self, split_fn: Callable[[Any], Any],
+              n_branches: int) -> "MultiPipe":
+        self._check_open()
+        return self.graph._execute_split(self, split_fn, n_branches)
+
+    def select(self, i: int) -> "MultiPipe":
+        if not self.children:
+            raise RuntimeError("select() on a non-split MultiPipe")
+        if not 0 <= i < len(self.children):
+            raise IndexError(i)
+        return self.children[i]
+
+    def merge(self, *others: "MultiPipe") -> "MultiPipe":
+        self._check_open()
+        return self.graph._execute_merge(self, others)
+
+    # -- execution ---------------------------------------------------------
+    def all_nodes(self) -> List[RtNode]:
+        out = list(self.nodes)
+        for c in self.children:
+            out.extend(c.all_nodes())
+        return out
+
+    def thread_count(self) -> int:
+        return len(self.all_nodes())

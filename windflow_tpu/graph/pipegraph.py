@@ -1,0 +1,188 @@
+"""PipeGraph: the application container.
+
+Re-design of reference ``wf/pipegraph.hpp`` (915 LoC): owns the
+application tree of MultiPipes (AppNode :67-79), ``add_source`` :560-574,
+``run`` = start + wait_end :580-736, split/merge executors :289-503, and
+the dropped-tuple counter :104/:763-766.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+from ..core.basic import Mode, RuntimeConfig
+from ..operators.base import Operator
+from ..runtime.emitters import SplittingEmitter
+from ..runtime.node import RtNode
+from .multipipe import MultiPipe
+
+
+class _AppNode:
+    """Application-tree node (pipegraph.hpp:67-79)."""
+
+    def __init__(self, mp: Optional[MultiPipe] = None, parent=None):
+        self.mp = mp
+        self.parent = parent
+        self.children: List["_AppNode"] = []
+
+
+class PipeGraph:
+    def __init__(self, name: str = "pipegraph", mode: Mode = Mode.DEFAULT,
+                 config: RuntimeConfig = None):
+        self.name = name
+        self.mode = mode
+        self.config = config or RuntimeConfig(mode=mode)
+        self.config.mode = mode
+        self.root = _AppNode()
+        self.pipes: List[MultiPipe] = []
+        self._dropped = 0
+        self._dropped_lock = threading.Lock()
+        self._started = False
+        self._ended = False
+        self._monitor = None
+        self._pipe_seq = 0
+
+    # -- construction ------------------------------------------------------
+    def _new_pipe(self) -> MultiPipe:
+        mp = MultiPipe(self, f"pipe{self._pipe_seq}")
+        self._pipe_seq += 1
+        self.pipes.append(mp)
+        return mp
+
+    def add_source(self, source: Operator) -> MultiPipe:
+        """Create a root MultiPipe fed by ``source``
+        (pipegraph.hpp:560-574)."""
+        mp = self._new_pipe()
+        mp.add_source(source)
+        self.root.children.append(_AppNode(mp, self.root))
+        return mp
+
+    def _count_dropped(self, n: int) -> None:
+        with self._dropped_lock:
+            self._dropped += n
+
+    def get_num_dropped_tuples(self) -> int:
+        return self._dropped
+
+    # -- split / merge executors (pipegraph.hpp:289-503) -------------------
+    def _find_app_node(self, node: _AppNode, mp: MultiPipe) -> Optional[_AppNode]:
+        if node.mp is mp:
+            return node
+        for c in node.children:
+            found = self._find_app_node(c, mp)
+            if found is not None:
+                return found
+        return None
+
+    def _execute_split(self, mp: MultiPipe, split_fn, n_branches: int) -> MultiPipe:
+        """Open n child MultiPipes fed through a SplittingEmitter
+        (pipegraph.hpp:289-328)."""
+        if n_branches < 2:
+            raise ValueError("split requires >= 2 branches")
+        app = self._find_app_node(self.root, mp)
+        if app is None:
+            raise RuntimeError("MultiPipe not part of this graph")
+        children = []
+        for b in range(n_branches):
+            child = self._new_pipe()
+            child.name = f"{mp.name}.b{b}"
+            child.has_source = True  # fed by the parent, not by a Source op
+            children.append(child)
+            app.children.append(_AppNode(child, app))
+        # wire: each tail gets a SplittingEmitter whose branch b leads to
+        # the (future) first operator of child b.  We defer binding by
+        # giving each child a relay channel the parent writes into.
+        from ..runtime.queues import Channel
+        from ..runtime.node import NodeLogic, Outlet
+
+        class _Relay(NodeLogic):
+            def svc(self, item, channel_id, emit):
+                emit(item)
+
+        cap = self.config.queue_capacity
+        relay_nodes = []
+        for child in children:
+            ch = Channel(cap)
+            relay = RtNode(f"{child.name}/relay", _Relay(), ch, [])
+            child.nodes.append(relay)
+            child.tails = [relay]
+            relay_nodes.append((ch, relay))
+        for tail in mp.tails:
+            em = SplittingEmitter(split_fn, n_branches)
+            em.set_n_destinations(n_branches)
+            dests = [(ch, ch.register_producer()) for ch, _ in relay_nodes]
+            tail.outlets.append(Outlet(em, dests))
+        mp.children = children
+        mp.tails = []
+        return mp
+
+    def _execute_merge(self, mp: MultiPipe,
+                       others: Sequence[MultiPipe]) -> MultiPipe:
+        """Merge sibling MultiPipes into a fresh one whose first operator
+        receives the union of their streams (pipegraph.hpp:331-503; the
+        merge-full/ind/partial distinction collapses here because wiring
+        is explicit)."""
+        all_pipes = [mp, *others]
+        for p in all_pipes:
+            if p.has_sink:
+                raise RuntimeError("cannot merge a terminated MultiPipe")
+            if not p.tails:
+                raise RuntimeError(f"MultiPipe {p.name} has no open tail")
+        merged = self._new_pipe()
+        merged.name = "+".join(p.name for p in all_pipes)
+        merged.has_source = True
+        merged.tails = [t for p in all_pipes for t in p.tails]
+        app = self._find_app_node(self.root, mp)
+        parent = app.parent if app is not None else self.root
+        parent.children.append(_AppNode(merged, parent))
+        for p in all_pipes:
+            p.merged_into = merged
+        return merged
+
+    # -- execution (pipegraph.hpp:580-736) ---------------------------------
+    def _all_nodes(self) -> List[RtNode]:
+        seen = set()
+        out = []
+        for p in self.pipes:
+            for n in p.nodes:
+                if id(n) not in seen:
+                    seen.add(id(n))
+                    out.append(n)
+        return out
+
+    def start(self) -> None:
+        if self._started:
+            raise RuntimeError("PipeGraph already started")
+        for p in self.pipes:
+            if not p.has_sink and not p.children and p.merged_into is None \
+                    and p.tails:
+                raise RuntimeError(
+                    f"MultiPipe {p.name} has no sink; terminate every "
+                    "branch before run()")
+        self._started = True
+        if self.config.tracing:
+            from ..monitoring.monitor import MonitoringThread
+            self._monitor = MonitoringThread(self)
+            self._monitor.start()
+        for n in self._all_nodes():
+            n.start()
+
+    def wait_end(self) -> None:
+        errors = []
+        for n in self._all_nodes():
+            n.join()
+            if n.error is not None:
+                errors.append((n.name, n.error))
+        self._ended = True
+        if self._monitor is not None:
+            self._monitor.stop()
+        if errors:
+            name, err = errors[0]
+            raise RuntimeError(f"node {name} failed: {err!r}") from err
+
+    def run(self) -> None:
+        self.start()
+        self.wait_end()
+
+    def thread_count(self) -> int:
+        return len(self._all_nodes())

@@ -1,0 +1,71 @@
+"""Operator descriptor framework.
+
+Reference analogue: ``wf/basic_operator.hpp`` (:49-89) plus the
+structural role the ff_farm/ff_pipeline nests play.  A windflow_tpu
+operator is a passive descriptor that yields one or more **stages**;
+each stage contributes replica logics, the emitter the upstream uses to
+route into it, its ordering requirement, and an optional farm-level
+collector.  MultiPipe consumes stages to wire channels/threads -- the
+flat, explicit substitute for the reference's "matrioska" ff_a2a
+nesting (multipipe.hpp:236-341).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..core.basic import OrderingMode, Pattern, RoutingMode
+from ..runtime.emitters import Emitter
+from ..runtime.node import NodeLogic
+
+
+@dataclass
+class StageSpec:
+    """One farm stage inside an operator."""
+
+    name: str
+    replicas: List[NodeLogic]
+    emitter_proto: Emitter              # cloned per upstream producer
+    routing: RoutingMode
+    # field the DETERMINISTIC/PROBABILISTIC collector must order on when
+    # one is inserted in front of each replica (None = operator does not
+    # care; graph mode decides)
+    ordering_mode: Optional[OrderingMode] = None
+    # farm-level collector merging replica outputs (e.g. ordered WF)
+    collector: Optional[NodeLogic] = None
+
+
+class Operator:
+    """Base descriptor: name, parallelism, routing, pattern."""
+
+    def __init__(self, name: str, parallelism: int, routing: RoutingMode,
+                 pattern: Pattern):
+        if parallelism < 1:
+            raise ValueError(f"operator {name}: parallelism must be >= 1")
+        self.name = name
+        self.parallelism = parallelism
+        self.routing = routing
+        self.pattern = pattern
+        self.used = False  # one operator object per graph position (ref basic_operator)
+
+    # -- to be provided by subclasses --------------------------------------
+    def stages(self) -> List[StageSpec]:
+        raise NotImplementedError
+
+    # chainable operators (Filter/Map/FlatMap/Sink) additionally expose
+    # fresh per-replica logics for thread fusion (multipipe.hpp:345-390)
+    def chain_logics(self) -> Optional[List[NodeLogic]]:
+        return None
+
+    def is_window_operator(self) -> bool:
+        return self.pattern in (
+            Pattern.WIN_SEQ, Pattern.WIN_FARM, Pattern.KEY_FARM,
+            Pattern.PANE_FARM, Pattern.WIN_MAPREDUCE, Pattern.WIN_SEQFFAT,
+            Pattern.KEY_FFAT, Pattern.WIN_SEQ_TPU, Pattern.WIN_FARM_TPU,
+            Pattern.KEY_FARM_TPU, Pattern.PANE_FARM_TPU,
+            Pattern.WIN_MAPREDUCE_TPU, Pattern.WIN_SEQFFAT_TPU,
+            Pattern.KEY_FFAT_TPU)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(name={self.name!r}, "
+                f"parallelism={self.parallelism})")

@@ -1,0 +1,246 @@
+"""Basic streaming operators: Source, Filter, Map, FlatMap, Accumulator, Sink.
+
+Re-designs of reference ``wf/source.hpp`` (439 LoC), ``filter.hpp``
+(574), ``map.hpp`` (471), ``flatmap.hpp`` (427), ``accumulator.hpp``
+(402), ``sink.hpp`` (498).  All follow the reference template
+(SURVEY.md §2.3): a farm of N replica logics, Standard emitter, plain +
+rich callable variants, closing function called at svc_end.
+
+Python signature conventions (replacing the C++ overload sets, API:11-43):
+* Source:      fn(shipper[, ctx]) -> bool     (loop/shipper style) or an
+               iterable/generator factory via SourceBuilder.
+* Filter:      fn(t[, ctx]) -> bool | None | record   (False/None drops;
+               a record transforms -- the optional<result_t> variant).
+* Map:         fn(t[, ctx]) -> None (in-place) | record (transform).
+* FlatMap:     fn(t, shipper[, ctx]) -> None.
+* Accumulator: fn(t, acc[, ctx]) -> None|acc  (keyed rolling fold,
+               acc seeded from init_value; result emitted per input).
+* Sink:        fn(t_or_None[, ctx]) -> None   (None signals stream end).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, List, Optional
+
+from ..core.basic import Pattern, RoutingMode, OrderingMode
+from ..core.context import RuntimeContext
+from ..core.meta import with_context
+from ..core.shipper import Shipper
+from ..runtime.emitters import StandardEmitter
+from ..runtime.node import EOSMarker, NodeLogic, SourceLoopLogic
+from .base import Operator, StageSpec
+
+
+def _noop_closing(ctx):
+    return None
+
+
+class _ReplicaLogic(NodeLogic):
+    """Common skeleton: context binding + closing function."""
+
+    def __init__(self, fn, base_arity, parallelism, replica_index,
+                 closing_func):
+        self.context = RuntimeContext(parallelism, replica_index)
+        self.fn = with_context(fn, base_arity, self.context)
+        self.closing_func = closing_func or _noop_closing
+
+    def svc_end(self):
+        self.closing_func(self.context)
+
+
+class SourceLogic(SourceLoopLogic):
+    """Shipper-style source: user fn pushes 0..N records, returns False
+    at end of stream (source.hpp:228-249)."""
+
+    def __init__(self, fn, parallelism, replica_index, closing_func):
+        self.context = RuntimeContext(parallelism, replica_index)
+        self.user_fn = with_context(fn, 1, self.context)
+        self.closing_func = closing_func or _noop_closing
+
+        def step(emit):
+            return self.user_fn(Shipper(emit))
+        super().__init__(step)
+
+    def svc_end(self):
+        self.closing_func(self.context)
+
+
+class FilterLogic(_ReplicaLogic):
+    def svc(self, item, channel_id, emit):
+        if isinstance(item, EOSMarker):
+            emit(item)
+            return
+        out = self.fn(item)
+        if out is None or out is False:
+            return  # dropped (empty optional, filter.hpp:260-296)
+        emit(item if out is True else out)
+
+
+class MapLogic(_ReplicaLogic):
+    def svc(self, item, channel_id, emit):
+        if isinstance(item, EOSMarker):
+            emit(item)
+            return
+        out = self.fn(item)
+        emit(item if out is None else out)
+
+
+class FlatMapLogic(_ReplicaLogic):
+    def __init__(self, fn, base_arity, parallelism, replica_index,
+                 closing_func):
+        super().__init__(fn, base_arity, parallelism, replica_index,
+                         closing_func)
+
+    def svc(self, item, channel_id, emit):
+        if isinstance(item, EOSMarker):
+            emit(item)
+            return
+        self.fn(item, Shipper(emit))
+
+
+class AccumulatorLogic(_ReplicaLogic):
+    """Keyed rolling fold (accumulator.hpp:98-177): per-key accumulator
+    seeded from ``init_value``; emits a snapshot after every input with
+    the input's control fields carried over."""
+
+    def __init__(self, fn, parallelism, replica_index, closing_func,
+                 init_value):
+        super().__init__(fn, 2, parallelism, replica_index, closing_func)
+        self.init_value = init_value
+        self.state = {}
+
+    def svc(self, item, channel_id, emit):
+        if isinstance(item, EOSMarker):
+            return
+        key, tid, ts = item.get_control_fields()
+        acc = self.state.get(key)
+        if acc is None:
+            acc = copy.deepcopy(self.init_value)
+            acc.set_control_fields(key, 0, 0)
+            self.state[key] = acc
+        ret = self.fn(item, acc)
+        if ret is not None:
+            acc = self.state[key] = ret
+        out = copy.copy(acc)
+        out.set_control_fields(key, tid, ts)
+        emit(out)
+
+
+class SinkLogic(_ReplicaLogic):
+    def __init__(self, fn, parallelism, replica_index, closing_func):
+        super().__init__(fn, 1, parallelism, replica_index, closing_func)
+
+    def svc(self, item, channel_id, emit):
+        if isinstance(item, EOSMarker):
+            return
+        self.fn(item)
+
+    def eos_flush(self, emit):
+        self.fn(None)  # empty optional = end of stream (sink.hpp:73-77)
+
+
+# ---------------------------------------------------------------------------
+# Operator descriptors
+# ---------------------------------------------------------------------------
+
+class Source(Operator):
+    def __init__(self, fn, parallelism=1, name="source", closing_func=None):
+        super().__init__(name, parallelism, RoutingMode.NONE, Pattern.SOURCE)
+        self.fn = fn
+        self.closing_func = closing_func
+
+    def stages(self):
+        reps = [SourceLogic(self.fn, self.parallelism, i, self.closing_func)
+                for i in range(self.parallelism)]
+        return [StageSpec(self.name, reps, StandardEmitter(), self.routing)]
+
+
+class _BasicOp(Operator):
+    logic_cls: type = None
+    base_arity: int = 1
+
+    def __init__(self, fn, parallelism, name, closing_func=None,
+                 keyed=False, pattern=None):
+        super().__init__(name, parallelism,
+                         RoutingMode.KEYBY if keyed else RoutingMode.FORWARD,
+                         pattern)
+        self.fn = fn
+        self.closing_func = closing_func
+        self.keyed = keyed
+
+    def _make_logic(self, i):
+        return self.logic_cls(self.fn, self.base_arity, self.parallelism, i,
+                              self.closing_func)
+
+    def stages(self):
+        reps = [self._make_logic(i) for i in range(self.parallelism)]
+        return [StageSpec(self.name, reps,
+                          StandardEmitter(keyed=self.keyed), self.routing,
+                          ordering_mode=OrderingMode.TS)]
+
+    def chain_logics(self):
+        if self.keyed:
+            return None  # KEYBY ops cannot be thread-fused (multipipe chain)
+        return [self._make_logic(i) for i in range(self.parallelism)]
+
+
+class Filter(_BasicOp):
+    logic_cls = FilterLogic
+    base_arity = 1
+
+    def __init__(self, fn, parallelism=1, name="filter", closing_func=None,
+                 keyed=False):
+        super().__init__(fn, parallelism, name, closing_func, keyed,
+                         Pattern.FILTER)
+
+
+class Map(_BasicOp):
+    logic_cls = MapLogic
+    base_arity = 1
+
+    def __init__(self, fn, parallelism=1, name="map", closing_func=None,
+                 keyed=False):
+        super().__init__(fn, parallelism, name, closing_func, keyed,
+                         Pattern.MAP)
+
+
+class FlatMap(_BasicOp):
+    logic_cls = FlatMapLogic
+    base_arity = 2
+
+    def __init__(self, fn, parallelism=1, name="flatmap", closing_func=None,
+                 keyed=False):
+        super().__init__(fn, parallelism, name, closing_func, keyed,
+                         Pattern.FLATMAP)
+
+
+class Accumulator(Operator):
+    """Always KEYBY (multipipe.hpp:967-973)."""
+
+    def __init__(self, fn, init_value, parallelism=1, name="accumulator",
+                 closing_func=None):
+        super().__init__(name, parallelism, RoutingMode.KEYBY,
+                         Pattern.ACCUMULATOR)
+        self.fn = fn
+        self.init_value = init_value
+        self.closing_func = closing_func
+
+    def stages(self):
+        reps = [AccumulatorLogic(self.fn, self.parallelism, i,
+                                 self.closing_func, self.init_value)
+                for i in range(self.parallelism)]
+        return [StageSpec(self.name, reps, StandardEmitter(keyed=True),
+                          self.routing, ordering_mode=OrderingMode.TS)]
+
+
+class Sink(_BasicOp):
+    logic_cls = SinkLogic
+    base_arity = 1
+
+    def __init__(self, fn, parallelism=1, name="sink", closing_func=None,
+                 keyed=False):
+        super().__init__(fn, parallelism, name, closing_func, keyed,
+                         Pattern.SINK)
+
+    def _make_logic(self, i):
+        return SinkLogic(self.fn, self.parallelism, i, self.closing_func)

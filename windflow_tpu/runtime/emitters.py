@@ -1,0 +1,136 @@
+"""Emitter family: the routing plane between operators.
+
+Re-design of reference L2 (SURVEY.md §2.2): an emitter decides, per
+item, which downstream replicas receive it.  Interface (the analogue of
+basic_emitter.hpp:40-58): ``emit(item, send_to)``, ``eos(send_to)`` for
+trailing markers, ``set_n_destinations``, ``clone``.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, List, Optional
+
+from ..core.meta import default_hash
+from .node import EOSMarker
+
+SendTo = Callable[[int, Any], None]
+
+
+class Emitter:
+    n_dest: int = 1
+
+    def set_n_destinations(self, n: int) -> None:
+        self.n_dest = n
+
+    def emit(self, item: Any, send_to: SendTo) -> None:
+        raise NotImplementedError
+
+    def eos(self, send_to: SendTo) -> None:
+        pass
+
+    def clone(self) -> "Emitter":
+        return copy.deepcopy(self)
+
+
+class StandardEmitter(Emitter):
+    """FORWARD round-robin or KEYBY hash routing
+    (standard_emitter.hpp:42-136)."""
+
+    def __init__(self, keyed: bool = False,
+                 key_of: Callable[[Any], Any] = None):
+        self.keyed = keyed
+        self.key_of = key_of or (lambda t: t.get_control_fields()[0])
+        self._rr = 0
+
+    def emit(self, item, send_to):
+        if self.n_dest == 1:
+            send_to(0, item)
+        elif self.keyed:
+            rec = item.record if isinstance(item, EOSMarker) else item
+            send_to(default_hash(self.key_of(rec)) % self.n_dest, item)
+        else:
+            send_to(self._rr, item)
+            self._rr = (self._rr + 1) % self.n_dest
+
+
+class BroadcastEmitter(Emitter):
+    """Replicates every item to all destinations
+    (broadcast_emitter.hpp:42-; refcounted in the reference, shared
+    object here -- downstream treats inputs as immutable)."""
+
+    def emit(self, item, send_to):
+        for d in range(self.n_dest):
+            send_to(d, item)
+
+
+class SplittingEmitter(Emitter):
+    """Runs the user splitting function returning one index or an
+    iterable of indices (splitting_emitter.hpp:41-152; signatures
+    API:165-172)."""
+
+    def __init__(self, split_fn: Callable[[Any], Any], n_branches: int):
+        self.split_fn = split_fn
+        self.n_branches = n_branches
+
+    def emit(self, item, send_to):
+        if isinstance(item, EOSMarker):
+            for d in range(self.n_dest):
+                send_to(d, item)
+            return
+        out = self.split_fn(item)
+        if isinstance(out, int):
+            out = (out,)
+        for d in out:
+            if d < 0 or d >= self.n_branches:
+                raise ValueError(
+                    f"splitting function returned branch {d} outside "
+                    f"[0, {self.n_branches})")
+            send_to(d, item)
+
+
+class TreeEmitter(Emitter):
+    """Two-level emitter composition: a root emitter routes to child
+    emitters whose channels are flattened to global destination indices
+    (tree_emitter.hpp:42-229; built by opt-level-2 fusion)."""
+
+    def __init__(self, root: Emitter, children: List[Emitter]):
+        self.root = root
+        self.children = [c.clone() for c in children]
+        self.root.set_n_destinations(len(self.children))
+        # children widths are set at wiring via set_child_widths
+        self._offsets: Optional[List[int]] = None
+
+    def set_child_widths(self, widths: List[int]) -> None:
+        assert len(widths) == len(self.children)
+        self._offsets = []
+        off = 0
+        for c, w in zip(self.children, widths):
+            c.set_n_destinations(w)
+            self._offsets.append(off)
+            off += w
+        self.n_dest = off
+
+    def emit(self, item, send_to):
+        assert self._offsets is not None, "TreeEmitter not wired"
+
+        def to_child(child_idx: int):
+            off = self._offsets[child_idx]
+
+            def send_child(d: int, it: Any):
+                send_to(off + d, it)
+            return send_child
+
+        self.root.emit(item, lambda ci, it: self.children[ci].emit(
+            it, to_child(ci)))
+
+    def eos(self, send_to):
+        def to_child(child_idx: int):
+            off = self._offsets[child_idx]
+
+            def send_child(d: int, it: Any):
+                send_to(off + d, it)
+            return send_child
+
+        self.root.eos(lambda ci, it: None)
+        for ci, c in enumerate(self.children):
+            c.eos(to_child(ci))

@@ -1,0 +1,130 @@
+"""Runtime nodes: the threaded executors of the host plane.
+
+The reference makes every operator replica an ``ff_node`` with a
+``svc()`` called per queue item (SURVEY.md §3.2).  windflow_tpu splits
+that into a passive **NodeLogic** (the operator semantics: svc /
+eos_flush / svc_end) and an active **RtNode** thread owning the input
+channel and an **Outlet** (emitter + destination channels).  This keeps
+operator logic runtime-agnostic: the same logic objects are driven by
+Python threads here and by the native C++ executor when built.
+"""
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Callable, List, Optional, Sequence
+
+from .queues import Channel
+
+
+class EOSMarker:
+    """A tuple travelling as an EOS marker (reference wraps the per-key
+    last tuple with an eos flag, meta.hpp:770-783 + wf_nodes.hpp:207-227):
+    it updates window triggering state downstream but carries no data."""
+
+    __slots__ = ("record",)
+
+    def __init__(self, record: Any):
+        self.record = record
+
+
+class NodeLogic:
+    """Base class for operator replica logic."""
+
+    def svc_init(self) -> None:
+        pass
+
+    def svc(self, item: Any, channel_id: int, emit: Callable[[Any], None]) -> None:
+        raise NotImplementedError
+
+    def eos_flush(self, emit: Callable[[Any], None]) -> None:
+        """Called once when all input producers reached EOS (the
+        ``eosnotify`` cascade, e.g. win_seq.hpp:514-579)."""
+
+    def svc_end(self) -> None:
+        pass
+
+
+class Outlet:
+    """Output side of a node: an emitter routing items to destination
+    channels.  ``dests`` is a list of (channel, producer_id)."""
+
+    __slots__ = ("emitter", "dests")
+
+    def __init__(self, emitter, dests: Sequence):
+        self.emitter = emitter
+        self.dests = list(dests)
+
+    @property
+    def n_destinations(self) -> int:
+        return len(self.dests)
+
+    def send_to(self, dest_idx: int, item: Any) -> None:
+        ch, pid = self.dests[dest_idx]
+        ch.put(pid, item)
+
+    def send(self, item: Any) -> None:
+        self.emitter.emit(item, self.send_to)
+
+    def flush_eos(self) -> None:
+        """Let the emitter publish trailing items (e.g. WF per-key EOS
+        markers), then close every destination once."""
+        self.emitter.eos(self.send_to)
+        for ch, pid in self.dests:
+            ch.close(pid)
+
+
+class RtNode(threading.Thread):
+    """One operator replica = one host thread (FastFlow analogue; thread
+    count report mirrors pipegraph.hpp:610-612)."""
+
+    def __init__(self, name: str, logic: NodeLogic, channel: Optional[Channel],
+                 outlets: Sequence[Outlet]):
+        super().__init__(name=name, daemon=True)
+        self.logic = logic
+        self.channel = channel
+        self.outlets = list(outlets)
+        self.error: Optional[BaseException] = None
+
+    def _emit(self, item: Any) -> None:
+        for o in self.outlets:
+            o.send(item)
+
+    def run(self) -> None:
+        try:
+            self.logic.svc_init()
+            if self.channel is not None:
+                while True:
+                    got = self.channel.get()
+                    if got is None:
+                        break
+                    cid, item = got
+                    self.logic.svc(item, cid, self._emit)
+            self.logic.eos_flush(self._emit)
+        except BaseException as e:  # surfaced by PipeGraph.wait_end
+            self.error = e
+            traceback.print_exc()
+        finally:
+            for o in self.outlets:
+                o.flush_eos()
+            try:
+                self.logic.svc_end()
+            except BaseException as e:
+                if self.error is None:
+                    self.error = e
+                traceback.print_exc()
+
+
+class SourceLoopLogic(NodeLogic):
+    """Drives a generation function with no input channel: the function
+    is called until it returns False (reference source.hpp:175-252)."""
+
+    def __init__(self, step: Callable[[Callable[[Any], None]], bool]):
+        self.step = step
+
+    def svc(self, item, channel_id, emit):  # pragma: no cover
+        raise RuntimeError("source has no inputs")
+
+    def eos_flush(self, emit):
+        while self.step(emit):
+            pass

@@ -1,0 +1,168 @@
+"""Ordering + K-slack collectors (the DETERMINISTIC / PROBABILISTIC plane).
+
+Re-designs of reference ``wf/ordering_node.hpp`` (watermark-by-min
+priority queues, :121-193; EOS flush :196-281) and ``wf/kslack_node.hpp``
+(adaptive K-slack buffering :93-139, late drops :193-200).
+"""
+from __future__ import annotations
+
+import bisect
+import heapq
+import itertools
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core.basic import OrderingMode
+from .node import EOSMarker, NodeLogic
+
+
+class _KeyState:
+    __slots__ = ("maxs", "heap", "eos_marker", "emit_counter")
+
+    def __init__(self, n_channels: int):
+        self.maxs = [0] * n_channels
+        self.heap: List = []
+        self.eos_marker: Optional[EOSMarker] = None
+        self.emit_counter = 0
+
+
+class OrderingLogic(NodeLogic):
+    """DETERMINISTIC-mode collector: buffers items in priority queues and
+    releases them once their id/ts is covered by the watermark = min of
+    per-channel maxima (ordering_node.hpp:121-193).
+
+    mode ID             -- per-key queues ordered by tuple id.
+    mode TS             -- one global queue ordered by timestamp.
+    mode TS_RENUMBERING -- TS ordering + per-key dense re-assignment of
+                           ids on emission (used for CB windows inside
+                           complex nestings, multipipe.hpp:1039-1051).
+    """
+
+    def __init__(self, mode: OrderingMode, n_channels: int):
+        self.mode = mode
+        self.n_channels = n_channels
+        self.keys: Dict[Any, _KeyState] = {}
+        self.global_heap: List = []
+        self.global_maxs = [0] * n_channels
+        self._seq = itertools.count()  # unique tiebreaker (ptr compare in ref)
+
+    def _key_state(self, key) -> _KeyState:
+        st = self.keys.get(key)
+        if st is None:
+            st = self.keys[key] = _KeyState(self.n_channels)
+        return st
+
+    def _order_field(self, rec) -> int:
+        k, tid, ts = rec.get_control_fields()
+        return tid if self.mode == OrderingMode.ID else ts
+
+    def _emit_rec(self, rec, emit, is_marker=False):
+        if self.mode == OrderingMode.TS_RENUMBERING:
+            key = rec.get_control_fields()[0]
+            st = self._key_state(key)
+            rec.set_control_fields(key, st.emit_counter,
+                                   rec.get_control_fields()[2])
+            st.emit_counter += 1
+        emit(EOSMarker(rec) if is_marker else rec)
+
+    def svc(self, item, channel_id, emit):
+        rec = item.record if isinstance(item, EOSMarker) else item
+        key = rec.get_control_fields()[0]
+        wid = self._order_field(rec)
+        st = self._key_state(key)
+        if isinstance(item, EOSMarker):
+            # keep only the most recent EOS marker per key (:136-150)
+            if st.eos_marker is None or wid > self._order_field(st.eos_marker.record):
+                st.eos_marker = item
+            return
+        if self.mode == OrderingMode.ID:
+            st.maxs[channel_id] = wid
+            min_id = min(st.maxs)
+            heap = st.heap
+        else:
+            self.global_maxs[channel_id] = wid
+            min_id = min(self.global_maxs)
+            heap = self.global_heap
+        heapq.heappush(heap, (wid, next(self._seq), rec))
+        while heap and heap[0][0] <= min_id:
+            _, _, nxt = heapq.heappop(heap)
+            self._emit_rec(nxt, emit)
+
+    def eos_flush(self, emit):
+        """Drain every queue in order, then re-publish the retained EOS
+        markers (ordering_node.hpp:196-281)."""
+        if self.mode == OrderingMode.ID:
+            for key, st in self.keys.items():
+                while st.heap:
+                    _, _, nxt = heapq.heappop(st.heap)
+                    self._emit_rec(nxt, emit)
+                if st.eos_marker is not None:
+                    self._emit_rec(st.eos_marker.record, emit, is_marker=True)
+        else:
+            while self.global_heap:
+                _, _, nxt = heapq.heappop(self.global_heap)
+                self._emit_rec(nxt, emit)
+            for key, st in self.keys.items():
+                if st.eos_marker is not None:
+                    self._emit_rec(st.eos_marker.record, emit, is_marker=True)
+
+
+class KSlackLogic(NodeLogic):
+    """PROBABILISTIC-mode collector: K-slack buffering with K adapted to
+    the maximum observed delay; tuples older than the emitted watermark
+    are dropped and counted (kslack_node.hpp:93-200).
+    """
+
+    def __init__(self, mode: OrderingMode = OrderingMode.TS,
+                 on_drop: Callable[[int], None] = None):
+        assert mode != OrderingMode.ID
+        self.mode = mode
+        self.K = 0
+        self.tcurr = 0
+        self.buffer_ts: List[int] = []   # sorted timestamps
+        self.buffer: List[Any] = []      # records, parallel to buffer_ts
+        self.ts_sample: List[int] = []   # delays sampled since last advance
+        self.last_timestamp = 0
+        self.dropped = 0
+        self.on_drop = on_drop or (lambda n: None)
+        self.key_counters: Dict[Any, int] = {}
+
+    def _emit_in_order(self, recs, emit):
+        for rec in recs:
+            ts = rec.get_control_fields()[2]
+            if ts < self.last_timestamp:
+                self.dropped += 1
+                self.on_drop(1)
+                continue
+            self.last_timestamp = ts
+            if self.mode == OrderingMode.TS_RENUMBERING:
+                key = rec.get_control_fields()[0]
+                c = self.key_counters.get(key, 0)
+                self.key_counters[key] = c + 1
+                rec.set_control_fields(key, c, ts)
+            emit(rec)
+
+    def svc(self, item, channel_id, emit):
+        rec = item.record if isinstance(item, EOSMarker) else item
+        ts = rec.get_control_fields()[2]
+        if isinstance(item, EOSMarker):
+            return  # markers carry no data; flush happens at EOS
+        self.ts_sample.append(ts)
+        i = bisect.bisect_left(self.buffer_ts, ts)
+        self.buffer_ts.insert(i, ts)
+        self.buffer.insert(i, rec)
+        if ts <= self.tcurr:
+            return
+        self.tcurr = ts
+        max_d = max(self.tcurr - t for t in self.ts_sample)
+        if max_d > self.K:
+            self.K = max_d
+        self.ts_sample.clear()
+        cut = bisect.bisect_left(self.buffer_ts, self.tcurr - self.K)
+        out, self.buffer = self.buffer[:cut], self.buffer[cut:]
+        del self.buffer_ts[:cut]
+        self._emit_in_order(out, emit)
+
+    def eos_flush(self, emit):
+        out, self.buffer = self.buffer, []
+        self.buffer_ts.clear()
+        self._emit_in_order(out, emit)

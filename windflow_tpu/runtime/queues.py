@@ -1,0 +1,62 @@
+"""Bounded channels of the host runtime plane.
+
+The reference rides FastFlow's lock-free SPSC queues with raw pointers
+(SURVEY.md §5 "Distributed communication backend"); windflow_tpu's host
+plane uses bounded MPSC channels with per-producer EOS accounting.  A
+consumer node owns exactly one channel; each upstream replica is a
+registered producer.  Backpressure = blocking bounded put (the analogue
+of FF_BOUNDED_BUFFER).  When the native C++ runtime is built
+(native/spsc.cpp), channels transparently use its ring buffers.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Any, List, Optional, Tuple
+
+from ..core.basic import DEFAULT_QUEUE_CAPACITY
+
+_EOS_SENTINEL = object()
+
+
+class Channel:
+    """Bounded multi-producer single-consumer channel.
+
+    Items are ``(producer_id, payload)``.  ``close(producer_id)`` enqueues
+    an EOS token for that producer; ``get()`` returns ``None`` once every
+    registered producer has closed (the FastFlow EOS-propagation analogue).
+    """
+
+    __slots__ = ("q", "n_producers", "_eos_seen", "_lock")
+
+    def __init__(self, capacity: int = DEFAULT_QUEUE_CAPACITY):
+        self.q: _queue.Queue = _queue.Queue(maxsize=capacity)
+        self.n_producers = 0
+        self._eos_seen = 0
+        self._lock = threading.Lock()
+
+    def register_producer(self) -> int:
+        with self._lock:
+            pid = self.n_producers
+            self.n_producers += 1
+            return pid
+
+    def put(self, producer_id: int, item: Any) -> None:
+        self.q.put((producer_id, item))
+
+    def close(self, producer_id: int) -> None:
+        self.q.put((producer_id, _EOS_SENTINEL))
+
+    def get(self) -> Optional[Tuple[int, Any]]:
+        """Next (channel_id, item); None when all producers closed."""
+        while True:
+            pid, item = self.q.get()
+            if item is _EOS_SENTINEL:
+                self._eos_seen += 1
+                if self._eos_seen >= self.n_producers:
+                    return None
+                continue
+            return pid, item
+
+    def qsize(self) -> int:
+        return self.q.qsize()
