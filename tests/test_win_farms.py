@@ -1,0 +1,191 @@
+"""End-to-end tests for the composite window operators, reference style:
+run the same graph with randomized parallelisms and check the aggregate
+of all window results against a sequential oracle (SURVEY.md §4).
+"""
+import random
+import threading
+
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core import BasicRecord, Mode, WinType
+
+
+def ordered_source(n_keys, per_key):
+    state = {}
+
+    def fn(shipper, ctx):
+        i = state.setdefault("i", 0)
+        if i >= n_keys * per_key:
+            return False
+        key = i % n_keys
+        tid = i // n_keys
+        shipper.push(BasicRecord(key, tid, tid, float(tid)))
+        state["i"] = i + 1
+        return True
+
+    return fn
+
+
+class Collector:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.results = []
+
+    def __call__(self, rec):
+        if rec is not None:
+            with self.lock:
+                self.results.append((rec.key, rec.id, rec.value))
+
+    def by_key(self):
+        out = {}
+        for k, g, v in self.results:
+            out.setdefault(k, {})[g] = v
+        return out
+
+    def total(self):
+        return sum(v for _, _, v in self.results)
+
+
+def sum_win(gwid, iterable, result):
+    result.value = sum(t.value for t in iterable)
+
+
+def run_graph(op, n_keys=3, per_key=48, mode=Mode.DEFAULT):
+    coll = Collector()
+    g = wf.PipeGraph("t", mode)
+    g.add_source(wf.SourceBuilder(ordered_source(n_keys, per_key)).build()) \
+        .add(op) \
+        .add_sink(wf.SinkBuilder(coll).build())
+    g.run()
+    return coll
+
+
+def oracle(per_key, win, slide):
+    """gwid -> sum over window [g*slide, g*slide+win) of ids 0..per_key-1,
+    including EOS-flushed partial windows (every window whose start was
+    reached)."""
+    out = {}
+    g = 0
+    while g * slide < per_key:
+        out[g] = float(sum(v for v in range(per_key)
+                           if g * slide <= v < g * slide + win))
+        g += 1
+    return out
+
+
+WIN_SLIDE = [(8, 8), (12, 4)]
+
+
+@pytest.mark.parametrize("win,slide", WIN_SLIDE)
+@pytest.mark.parametrize("par", [1, 2, 4])
+@pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
+def test_win_farm_matches_oracle(win, slide, par, win_type):
+    b = wf.WinFarmBuilder(sum_win).with_parallelism(par).with_ordered()
+    b = (b.with_cb_windows(win, slide) if win_type == WinType.CB
+         else b.with_tb_windows(win, slide))
+    mode = Mode.DETERMINISTIC if win_type == WinType.CB else Mode.DEFAULT
+    coll = run_graph(b.build(), mode=mode)
+    expect = oracle(48, win, slide)
+    assert coll.by_key() == {k: expect for k in range(3)}
+
+
+@pytest.mark.parametrize("win,slide", WIN_SLIDE)
+@pytest.mark.parametrize("par", [1, 3])
+@pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
+def test_key_farm_matches_oracle(win, slide, par, win_type):
+    b = wf.KeyFarmBuilder(sum_win).with_parallelism(par)
+    b = (b.with_cb_windows(win, slide) if win_type == WinType.CB
+         else b.with_tb_windows(win, slide))
+    coll = run_graph(b.build(), n_keys=5)
+    expect = oracle(48, win, slide)
+    assert coll.by_key() == {k: expect for k in range(5)}
+
+
+@pytest.mark.parametrize("win,slide", [(8, 8), (12, 4), (10, 5)])
+@pytest.mark.parametrize("pars", [(1, 1), (2, 2), (3, 1)])
+@pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
+def test_pane_farm_matches_oracle(win, slide, pars, win_type):
+    def comb_win(gwid, iterable, result):
+        result.value = sum(t.value for t in iterable)
+
+    b = wf.PaneFarmBuilder(sum_win, comb_win).with_parallelism(*pars)
+    b = (b.with_cb_windows(win, slide) if win_type == WinType.CB
+         else b.with_tb_windows(win, slide))
+    coll = run_graph(b.build(), n_keys=3, per_key=48)
+    expect = oracle(48, win, slide)
+    got = coll.by_key()
+    assert set(got) == {0, 1, 2}
+    for k in got:
+        assert got[k] == expect, (k, got[k], expect)
+
+
+@pytest.mark.parametrize("win,slide", [(8, 8), (12, 4)])
+@pytest.mark.parametrize("pars", [(2, 1), (3, 2)])
+@pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
+def test_win_mapreduce_matches_oracle(win, slide, pars, win_type):
+    def red_win(gwid, iterable, result):
+        result.value = sum(t.value for t in iterable)
+
+    b = wf.WinMapReduceBuilder(sum_win, red_win).with_parallelism(*pars)
+    b = (b.with_cb_windows(win, slide) if win_type == WinType.CB
+         else b.with_tb_windows(win, slide))
+    coll = run_graph(b.build(), n_keys=3, per_key=48)
+    expect = oracle(48, win, slide)
+    got = coll.by_key()
+    assert set(got) == {0, 1, 2}
+    for k in got:
+        assert got[k] == expect, (k, got[k], expect)
+
+
+def lift(t, result):
+    result.value = t.value
+
+
+def comb(a, b, out):
+    out.value = a.value + b.value
+
+
+@pytest.mark.parametrize("win,slide", WIN_SLIDE)
+@pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
+def test_win_seqffat_matches_oracle(win, slide, win_type):
+    b = wf.WinSeqFFATBuilder(lift, comb)
+    b = (b.with_cb_windows(win, slide) if win_type == WinType.CB
+         else b.with_tb_windows(win, slide))
+    coll = run_graph(b.build(), n_keys=3)
+    expect = oracle(48, win, slide)
+    assert coll.by_key() == {k: expect for k in range(3)}
+
+
+@pytest.mark.parametrize("par", [1, 3])
+@pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
+def test_key_ffat_matches_oracle(par, win_type):
+    win, slide = 12, 4
+    b = wf.KeyFFATBuilder(lift, comb).with_parallelism(par)
+    b = (b.with_cb_windows(win, slide) if win_type == WinType.CB
+         else b.with_tb_windows(win, slide))
+    coll = run_graph(b.build(), n_keys=5)
+    expect = oracle(48, win, slide)
+    assert coll.by_key() == {k: expect for k in range(5)}
+
+
+def test_wf_cb_default_mode_rejected():
+    b = wf.WinFarmBuilder(sum_win).with_parallelism(2).with_cb_windows(4, 4)
+    g = wf.PipeGraph("t", Mode.DEFAULT)
+    pipe = g.add_source(wf.SourceBuilder(ordered_source(1, 8)).build())
+    with pytest.raises(RuntimeError, match="DEFAULT"):
+        pipe.add(b.build())
+
+
+def test_randomized_parallelism_determinism():
+    """The reference oracle: randomized parallelisms, same aggregate
+    (test_mp_*.cpp pattern)."""
+    rnd = random.Random(123)
+    totals = set()
+    for _ in range(4):
+        par = rnd.randint(1, 5)
+        b = wf.KeyFarmBuilder(sum_win).with_parallelism(par) \
+            .with_tb_windows(10, 5)
+        coll = run_graph(b.build(), n_keys=7, per_key=60)
+        totals.add(coll.total())
+    assert len(totals) == 1

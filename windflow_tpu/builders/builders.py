@@ -200,3 +200,161 @@ class WinSeqBuilder(_WinBuilderBase):
         return WinSeq(self.fn, self.win_len, self.slide_len, self.win_type,
                       self.triggering_delay, self.incremental, self.name,
                       self.result_factory, self.closing_func)
+
+
+from ..operators.win_farm import WinFarm
+from ..operators.key_farm import KeyFarm
+from ..operators.pane_farm import PaneFarm
+from ..operators.win_mapreduce import WinMapReduce
+from ..operators.win_seqffat import KeyFFAT, WinSeqFFAT
+
+
+@_alias_camel
+class WinFarmBuilder(_WinBuilderBase):
+    """builders.hpp:1127 -- window-parallel farm."""
+
+    _default_name = "win_farm"
+
+    def __init__(self, fn):
+        super().__init__(fn)
+        self.ordered = True
+
+    def with_ordered(self, ordered: bool = True):
+        self.ordered = ordered
+        return self
+
+    def build(self) -> WinFarm:
+        self._check_windows()
+        return WinFarm(self.fn, self.win_len, self.slide_len, self.win_type,
+                       self.parallelism, self.triggering_delay,
+                       self.incremental, self.name, self.result_factory,
+                       self.closing_func, self.ordered, self.opt_level)
+
+
+@_alias_camel
+class KeyFarmBuilder(_WinBuilderBase):
+    """builders.hpp:1350 -- key-partitioned farm."""
+
+    _default_name = "key_farm"
+
+    def build(self) -> KeyFarm:
+        self._check_windows()
+        return KeyFarm(self.fn, self.win_len, self.slide_len, self.win_type,
+                       self.parallelism, self.triggering_delay,
+                       self.incremental, self.name, self.result_factory,
+                       self.closing_func, self.opt_level)
+
+
+class _TwoStageWinBuilder(_WinBuilderBase):
+    """Shared by PaneFarm (PLQ/WLQ) and WinMapReduce (MAP/REDUCE)."""
+
+    def __init__(self, fn1, fn2):
+        super().__init__(fn1)
+        self.fn2 = fn2
+        self.par1 = 1
+        self.par2 = 1
+        self.incremental2 = False
+        self.ordered = True
+
+    def with_ordered(self, ordered: bool = True):
+        self.ordered = ordered
+        return self
+
+
+@_alias_camel
+class PaneFarmBuilder(_TwoStageWinBuilder):
+    """builders.hpp:1762 -- pane decomposition (PLQ + WLQ)."""
+
+    _default_name = "pane_farm"
+
+    def with_parallelism(self, plq: int, wlq: int = None):
+        self.par1 = plq
+        self.par2 = wlq if wlq is not None else plq
+        return self
+
+    withParallelism = with_parallelism
+
+    def with_plq_incremental(self, inc: bool = True):
+        self.incremental = inc
+        return self
+
+    def with_wlq_incremental(self, inc: bool = True):
+        self.incremental2 = inc
+        return self
+
+    def build(self) -> PaneFarm:
+        self._check_windows()
+        return PaneFarm(self.fn, self.fn2, self.win_len, self.slide_len,
+                        self.win_type, self.par1, self.par2,
+                        self.triggering_delay, self.incremental,
+                        self.incremental2, self.name, self.result_factory,
+                        self.closing_func, self.ordered, self.opt_level)
+
+
+@_alias_camel
+class WinMapReduceBuilder(_TwoStageWinBuilder):
+    """builders.hpp:1982 -- intra-window map + reduce."""
+
+    _default_name = "win_mr"
+
+    def __init__(self, map_fn, reduce_fn):
+        super().__init__(map_fn, reduce_fn)
+        self.par1 = 2
+
+    def with_parallelism(self, map_par: int, reduce_par: int = 1):
+        self.par1 = map_par
+        self.par2 = reduce_par
+        return self
+
+    withParallelism = with_parallelism
+
+    def with_map_incremental(self, inc: bool = True):
+        self.incremental = inc
+        return self
+
+    def with_reduce_incremental(self, inc: bool = True):
+        self.incremental2 = inc
+        return self
+
+    def build(self) -> WinMapReduce:
+        self._check_windows()
+        return WinMapReduce(self.fn, self.fn2, self.win_len, self.slide_len,
+                            self.win_type, self.par1, self.par2,
+                            self.triggering_delay, self.incremental,
+                            self.incremental2, self.name,
+                            self.result_factory, self.closing_func,
+                            self.ordered, self.opt_level)
+
+
+class _FFATBuilderBase(_WinBuilderBase):
+    def __init__(self, lift_fn, combine_fn):
+        super().__init__(lift_fn)
+        self.combine_fn = combine_fn
+
+
+@_alias_camel
+class WinSeqFFATBuilder(_FFATBuilderBase):
+    """builders.hpp:957 -- sequential FlatFAT engine (lift + combine)."""
+
+    _default_name = "win_seqffat"
+
+    def build(self) -> WinSeqFFAT:
+        self._check_windows()
+        return WinSeqFFAT(self.fn, self.combine_fn, self.win_len,
+                          self.slide_len, self.win_type,
+                          self.triggering_delay, self.name,
+                          self.result_factory, self.closing_func)
+
+
+@_alias_camel
+class KeyFFATBuilder(_FFATBuilderBase):
+    """builders.hpp:1576 -- key-parallel FlatFAT farm (lift + combine)."""
+
+    _default_name = "key_ffat"
+
+    def build(self) -> KeyFFAT:
+        self._check_windows()
+        return KeyFFAT(self.fn, self.combine_fn, self.win_len,
+                       self.slide_len, self.win_type, self.parallelism,
+                       self.triggering_delay, self.name,
+                       self.result_factory, self.closing_func)

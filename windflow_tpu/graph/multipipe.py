@@ -155,6 +155,15 @@ class MultiPipe:
         self._check_open()
         self._mark_used(op)
         win_type = getattr(op, "win_type", None)
+        # Win_Farm with CB windows is rejected in DEFAULT mode: window
+        # multicast cannot renumber consistently (multipipe.hpp:1002-1006)
+        from ..core.basic import Pattern, Role
+        if (self.graph.mode == Mode.DEFAULT and win_type == WinType.CB
+                and op.pattern in (Pattern.WIN_FARM, Pattern.WIN_FARM_TPU)
+                and getattr(op, "role", Role.SEQ) == Role.SEQ):
+            raise RuntimeError(
+                "Win_Farm with count-based windows cannot be used in "
+                "DEFAULT mode; use DETERMINISTIC mode")
         # CB windows in DEFAULT mode: renumber ids on arrival
         # (win_seq.hpp:342-347 via multipipe wiring)
         if (self.graph.mode == Mode.DEFAULT and win_type == WinType.CB
