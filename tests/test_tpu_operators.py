@@ -1,0 +1,242 @@
+"""Tests for the device-batched (TPU) window operators.
+
+Mirror of tests/mp_tests_gpu (SURVEY.md §4): identical fixtures to the
+CPU tests, device engines, varying batch lengths, aggregate oracle.
+Runs on the JAX CPU backend in CI (conftest.py); the same programs
+compile for TPU unchanged.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core import BasicRecord, Mode, WinType
+from windflow_tpu.ops.window_compute import WindowComputeEngine
+from windflow_tpu.ops.flatfat_jax import FlatFATJax
+
+
+def ordered_source(n_keys, per_key):
+    state = {}
+
+    def fn(shipper, ctx):
+        i = state.setdefault("i", 0)
+        if i >= n_keys * per_key:
+            return False
+        key = i % n_keys
+        tid = i // n_keys
+        shipper.push(BasicRecord(key, tid, tid, float(tid)))
+        state["i"] = i + 1
+        return True
+
+    return fn
+
+
+class Collector:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.results = []
+
+    def __call__(self, rec):
+        if rec is not None:
+            with self.lock:
+                self.results.append((rec.key, rec.id, rec.value))
+
+    def by_key(self):
+        out = {}
+        for k, g, v in self.results:
+            out.setdefault(k, {})[g] = v
+        return out
+
+
+def oracle(per_key, win, slide, agg=sum):
+    out = {}
+    g = 0
+    while g * slide < per_key:
+        vals = [float(v) for v in range(per_key)
+                if g * slide <= v < g * slide + win]
+        out[g] = float(agg(vals)) if vals else 0.0
+        g += 1
+    return out
+
+
+def run_graph(op, n_keys=3, per_key=48, mode=Mode.DEFAULT):
+    coll = Collector()
+    g = wf.PipeGraph("t", mode)
+    g.add_source(wf.SourceBuilder(ordered_source(n_keys, per_key)).build()) \
+        .add(op).add_sink(wf.SinkBuilder(coll).build())
+    g.run()
+    return coll
+
+
+class TestWindowComputeEngine:
+    def test_scan_sum(self):
+        eng = WindowComputeEngine("sum")
+        vals = np.arange(20, dtype=np.float64)
+        starts = np.array([0, 5, 10])
+        ends = np.array([5, 10, 20])
+        out = eng.compute({"value": vals}, starts, ends,
+                          np.arange(3)).block()
+        np.testing.assert_allclose(out, [10, 35, 145])
+
+    def test_sparse_table_max(self):
+        rng = np.random.default_rng(0)
+        vals = rng.normal(size=100)
+        starts = np.array([0, 10, 50, 93])
+        ends = np.array([7, 30, 82, 100])
+        eng = WindowComputeEngine("max")
+        out = eng.compute({"value": vals}, starts, ends,
+                          np.arange(4)).block()
+        expect = [vals[s:e].max() for s, e in zip(starts, ends)]
+        np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+    def test_custom_fn(self):
+        import jax.numpy as jnp
+
+        def fn(gwid, cols, mask):
+            v = jnp.where(mask, cols["value"], 0.0)
+            return jnp.sum(v * v)
+
+        vals = np.arange(10, dtype=np.float64)
+        eng = WindowComputeEngine(fn)
+        out = eng.compute({"value": vals}, np.array([0, 4]),
+                          np.array([4, 10]), np.arange(2)).block()
+        np.testing.assert_allclose(out, [sum(v * v for v in range(4)),
+                                         sum(v * v for v in range(4, 10))])
+
+    def test_ffat_kind(self):
+        import jax.numpy as jnp
+        eng = WindowComputeEngine(("ffat", jnp.add, 0.0))
+        vals = np.arange(32, dtype=np.float64)
+        starts = np.array([0, 8, 3])
+        ends = np.array([8, 32, 5])
+        out = eng.compute({"value": vals}, starts, ends,
+                          np.arange(3)).block()
+        np.testing.assert_allclose(out, [28, 468, 7])
+
+
+class TestFlatFATJax:
+    def test_build_query(self):
+        import jax.numpy as jnp
+        f = FlatFATJax(jnp.add, 0.0, 16, dtype=np.float64)
+        f.build(np.arange(16, dtype=np.float64))
+        out = f.query_ranges(np.array([0, 4, 15]), np.array([16, 8, 16]))
+        np.testing.assert_allclose(out, [120, 22, 15])
+
+    def test_update(self):
+        import jax.numpy as jnp
+        f = FlatFATJax(jnp.maximum, -np.inf, 8, dtype=np.float64)
+        f.build(np.arange(8, dtype=np.float64))
+        f.update(np.array([0, 3]), np.array([100.0, -5.0]))
+        out = f.query_ranges(np.array([0, 2]), np.array([8, 4]))
+        np.testing.assert_allclose(out, [100.0, 2.0])
+
+    def test_randomized_min_queries(self):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(3)
+        vals = rng.normal(size=64)
+        f = FlatFATJax(jnp.minimum, np.inf, 64, dtype=np.float64)
+        f.build(vals)
+        starts = rng.integers(0, 60, size=20)
+        ends = starts + rng.integers(1, 4, size=20)
+        out = f.query_ranges(starts, ends)
+        expect = [vals[s:e].min() for s, e in zip(starts, ends)]
+        np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+@pytest.mark.parametrize("win,slide", [(8, 8), (12, 4)])
+@pytest.mark.parametrize("batch", [1, 7, 64, 1024])
+@pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
+def test_win_seq_tpu_matches_oracle(win, slide, batch, win_type):
+    b = wf.WinSeqTPUBuilder("sum").with_batch(batch)
+    b = (b.with_cb_windows(win, slide) if win_type == WinType.CB
+         else b.with_tb_windows(win, slide))
+    coll = run_graph(b.build())
+    expect = oracle(48, win, slide)
+    assert coll.by_key() == {k: expect for k in range(3)}
+
+
+@pytest.mark.parametrize("kind,agg", [("max", max), ("min", min),
+                                      ("count", len)])
+def test_win_seq_tpu_builtin_kinds(kind, agg):
+    b = wf.WinSeqTPUBuilder(kind).with_batch(16).with_tb_windows(12, 4)
+    coll = run_graph(b.build())
+    expect = oracle(48, 12, 4, agg=agg)
+    assert coll.by_key() == {k: expect for k in range(3)}
+
+
+@pytest.mark.parametrize("par", [1, 3])
+@pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
+def test_key_farm_tpu(par, win_type):
+    b = wf.KeyFarmTPUBuilder("sum").with_parallelism(par).with_batch(8)
+    b = (b.with_cb_windows(12, 4) if win_type == WinType.CB
+         else b.with_tb_windows(12, 4))
+    coll = run_graph(b.build(), n_keys=5)
+    expect = oracle(48, 12, 4)
+    assert coll.by_key() == {k: expect for k in range(5)}
+
+
+@pytest.mark.parametrize("par", [2, 4])
+@pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
+def test_win_farm_tpu(par, win_type):
+    b = wf.WinFarmTPUBuilder("sum").with_parallelism(par).with_batch(4)
+    b = (b.with_cb_windows(12, 4) if win_type == WinType.CB
+         else b.with_tb_windows(12, 4))
+    mode = Mode.DETERMINISTIC if win_type == WinType.CB else Mode.DEFAULT
+    coll = run_graph(b.build(), mode=mode)
+    expect = oracle(48, 12, 4)
+    assert coll.by_key() == {k: expect for k in range(3)}
+
+
+@pytest.mark.parametrize("plq_on_tpu", [True, False])
+def test_pane_farm_tpu(plq_on_tpu):
+    def host_comb(gwid, iterable, result):
+        result.value = sum(t.value for t in iterable)
+
+    if plq_on_tpu:
+        b = wf.PaneFarmTPUBuilder("sum", host_comb, plq_on_tpu=True)
+    else:
+        b = wf.PaneFarmTPUBuilder(host_comb, "sum", plq_on_tpu=False)
+    coll = run_graph(b.with_parallelism(2, 1).with_batch(8)
+                     .with_tb_windows(12, 4).build())
+    expect = oracle(48, 12, 4)
+    got = coll.by_key()
+    for k in range(3):
+        assert got[k] == expect, (k, got[k])
+
+
+@pytest.mark.parametrize("map_on_tpu", [True, False])
+def test_win_mapreduce_tpu(map_on_tpu):
+    def host_fn(gwid, iterable, result):
+        result.value = sum(t.value for t in iterable)
+
+    if map_on_tpu:
+        b = wf.WinMapReduceTPUBuilder("sum", host_fn, map_on_tpu=True)
+    else:
+        b = wf.WinMapReduceTPUBuilder(host_fn, "sum", map_on_tpu=False)
+    coll = run_graph(b.with_parallelism(3, 1).with_batch(8)
+                     .with_tb_windows(12, 4).build())
+    expect = oracle(48, 12, 4)
+    got = coll.by_key()
+    for k in range(3):
+        assert got[k] == expect, (k, got[k])
+
+
+@pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
+def test_key_ffat_tpu(win_type):
+    import jax.numpy as jnp
+    b = wf.KeyFFATTPUBuilder(lambda t: t.value, (jnp.add, 0.0)) \
+        .with_parallelism(2).with_batch(8)
+    b = (b.with_cb_windows(12, 4) if win_type == WinType.CB
+         else b.with_tb_windows(12, 4))
+    coll = run_graph(b.build(), n_keys=4)
+    expect = oracle(48, 12, 4)
+    assert coll.by_key() == {k: expect for k in range(4)}
+
+
+def test_win_seqffat_tpu_builtin():
+    b = wf.WinSeqFFATTPUBuilder(lambda t: t.value, "max") \
+        .with_batch(16).with_tb_windows(10, 5)
+    coll = run_graph(b.build())
+    expect = oracle(48, 10, 5, agg=max)
+    assert coll.by_key() == {k: expect for k in range(3)}

@@ -1,0 +1,222 @@
+"""Device-operator builders: the TPU twin of ``wf/builders_gpu.hpp``.
+
+The reference's GPU builders add ``withBatch(batch_len)`` and
+``withGPUConfiguration(gpu_id, n_thread_block)`` (builders_gpu.hpp:120,
+:133); these builders keep ``withBatch`` and replace the CUDA knobs with
+``withTPUConfiguration(device_index)`` -- block shaping is the XLA
+compiler's job, not the user's.  Per the BASELINE north star, every
+builder also exposes ``withTPU()`` as a no-op marker so reference-style
+code reads naturally.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..core.basic import OptLevel
+from ..operators.tpu.farms_tpu import (KeyFarmTPU, KeyFFATTPU, PaneFarmTPU,
+                                       WinFarmTPU, WinMapReduceTPU,
+                                       WinSeqFFATTPU)
+from ..operators.tpu.win_seq_tpu import DEFAULT_BATCH_LEN, WinSeqTPU
+from .builders import _BuilderBase, _WinBuilderBase, _alias_camel
+
+
+class _TPUBuilderMixin:
+    def with_batch(self, batch_len: int):
+        self.batch_len = batch_len
+        return self
+
+    withBatch = with_batch
+
+    def with_tpu_configuration(self, device_index: int = 0):
+        self.device_index = device_index
+        return self
+
+    withTPUConfiguration = with_tpu_configuration
+
+    def with_tpu(self):
+        return self
+
+    withTPU = with_tpu
+
+    def with_value_of(self, value_of: Callable[[Any], float]):
+        """Host-side extractor tuple -> float fed to the device batch
+        (the staging-format hook; defaults to ``t.value``)."""
+        self.value_of = value_of
+        return self
+
+    withValueOf = with_value_of
+
+
+@_alias_camel
+class WinSeqTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
+    """builders_gpu.hpp:50 analogue."""
+
+    _default_name = "win_seq_tpu"
+
+    def __init__(self, win_kind):
+        super().__init__(win_kind)
+        self.batch_len = DEFAULT_BATCH_LEN
+        self.value_of = None
+        self.device_index = 0
+
+    def build(self) -> WinSeqTPU:
+        self._check_windows()
+        return WinSeqTPU(self.fn, self.win_len, self.slide_len,
+                         self.win_type, self.batch_len,
+                         self.triggering_delay, self.name,
+                         self.result_factory, self.value_of,
+                         self.closing_func)
+
+
+@_alias_camel
+class WinFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
+    """builders_gpu.hpp:426 analogue."""
+
+    _default_name = "win_farm_tpu"
+
+    def __init__(self, win_kind):
+        super().__init__(win_kind)
+        self.batch_len = DEFAULT_BATCH_LEN
+        self.value_of = None
+        self.device_index = 0
+        self.ordered = True
+
+    def with_ordered(self, ordered: bool = True):
+        self.ordered = ordered
+        return self
+
+    def build(self) -> WinFarmTPU:
+        self._check_windows()
+        return WinFarmTPU(self.fn, self.win_len, self.slide_len,
+                          self.win_type, self.parallelism, self.batch_len,
+                          self.triggering_delay, self.name,
+                          self.result_factory, self.value_of, self.ordered,
+                          self.opt_level)
+
+
+@_alias_camel
+class KeyFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
+    """builders_gpu.hpp:713 analogue."""
+
+    _default_name = "key_farm_tpu"
+
+    def __init__(self, win_kind):
+        super().__init__(win_kind)
+        self.batch_len = DEFAULT_BATCH_LEN
+        self.value_of = None
+        self.device_index = 0
+
+    def build(self) -> KeyFarmTPU:
+        self._check_windows()
+        return KeyFarmTPU(self.fn, self.win_len, self.slide_len,
+                          self.win_type, self.parallelism, self.batch_len,
+                          self.triggering_delay, self.name,
+                          self.result_factory, self.value_of)
+
+
+@_alias_camel
+class PaneFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
+    """builders_gpu.hpp:1217 analogue: exactly one of PLQ/WLQ on device."""
+
+    _default_name = "pane_farm_tpu"
+
+    def __init__(self, plq, wlq, plq_on_tpu: bool = True):
+        super().__init__(plq)
+        self.wlq = wlq
+        self.plq_on_tpu = plq_on_tpu
+        self.par1 = 1
+        self.par2 = 1
+        self.batch_len = DEFAULT_BATCH_LEN
+        self.value_of = None
+        self.device_index = 0
+        self.ordered = True
+
+    def with_parallelism(self, plq: int, wlq: int = None):
+        self.par1 = plq
+        self.par2 = wlq if wlq is not None else plq
+        return self
+
+    withParallelism = with_parallelism
+
+    def build(self) -> PaneFarmTPU:
+        self._check_windows()
+        return PaneFarmTPU(self.fn, self.wlq, self.win_len, self.slide_len,
+                           self.win_type, self.par1, self.par2,
+                           self.plq_on_tpu, not self.plq_on_tpu,
+                           self.batch_len, self.triggering_delay, self.name,
+                           self.result_factory, self.value_of, self.ordered,
+                           self.opt_level)
+
+
+@_alias_camel
+class WinMapReduceTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
+    """builders_gpu.hpp:1482 analogue: exactly one of MAP/REDUCE on device."""
+
+    _default_name = "win_mr_tpu"
+
+    def __init__(self, map_stage, reduce_stage, map_on_tpu: bool = True):
+        super().__init__(map_stage)
+        self.reduce_stage = reduce_stage
+        self.map_on_tpu = map_on_tpu
+        self.par1 = 2
+        self.par2 = 1
+        self.batch_len = DEFAULT_BATCH_LEN
+        self.value_of = None
+        self.device_index = 0
+        self.ordered = True
+
+    def with_parallelism(self, map_par: int, reduce_par: int = 1):
+        self.par1 = map_par
+        self.par2 = reduce_par
+        return self
+
+    withParallelism = with_parallelism
+
+    def build(self) -> WinMapReduceTPU:
+        self._check_windows()
+        return WinMapReduceTPU(self.fn, self.reduce_stage, self.win_len,
+                               self.slide_len, self.win_type, self.par1,
+                               self.par2, self.map_on_tpu, self.batch_len,
+                               self.triggering_delay, self.name,
+                               self.result_factory, self.value_of,
+                               self.ordered)
+
+
+@_alias_camel
+class WinSeqFFATTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
+    """builders_gpu.hpp:232 analogue (lift + combine)."""
+
+    _default_name = "win_seqffat_tpu"
+
+    def __init__(self, lift, combine):
+        super().__init__(lift)
+        self.combine = combine
+        self.batch_len = DEFAULT_BATCH_LEN
+        self.device_index = 0
+
+    def build(self) -> WinSeqFFATTPU:
+        self._check_windows()
+        return WinSeqFFATTPU(self.fn, self.combine, self.win_len,
+                             self.slide_len, self.win_type, self.batch_len,
+                             self.triggering_delay, self.name,
+                             self.result_factory)
+
+
+@_alias_camel
+class KeyFFATTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
+    """builders_gpu.hpp:1003 analogue (lift + combine, key-sharded)."""
+
+    _default_name = "key_ffat_tpu"
+
+    def __init__(self, lift, combine):
+        super().__init__(lift)
+        self.combine = combine
+        self.batch_len = DEFAULT_BATCH_LEN
+        self.device_index = 0
+
+    def build(self) -> KeyFFATTPU:
+        self._check_windows()
+        return KeyFFATTPU(self.fn, self.combine, self.win_len,
+                          self.slide_len, self.win_type, self.parallelism,
+                          self.batch_len, self.triggering_delay, self.name,
+                          self.result_factory)

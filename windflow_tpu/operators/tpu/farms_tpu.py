@@ -1,0 +1,390 @@
+"""Device-batched composite window operators: the TPU twins of the
+reference's GPU operator family (SURVEY.md §2.5).
+
+* KeyFarmTPU       <- key_farm_gpu.hpp (751)
+* WinFarmTPU       <- win_farm_gpu.hpp (782)
+* PaneFarmTPU      <- pane_farm_gpu.hpp (1028): PLQ *or* WLQ on device
+* WinMapReduceTPU  <- win_mapreduce_gpu.hpp (1046): MAP *or* REDUCE on device
+* WinSeqFFATTPU    <- win_seqffat_gpu.hpp (734): lift on host, FlatFAT
+                      aggregation on device (ops/flatfat_jax)
+* KeyFFATTPU       <- key_ffat_gpu.hpp (345)
+
+All reuse the CPU composites' WinOperatorConfig arithmetic; only the
+engine replica type changes (WinSeqTPULogic instead of WinSeqLogic) --
+mirroring how the reference swaps Win_Seq for Win_Seq_GPU inside the
+same farm skeletons (win_farm_gpu.hpp:82-86).
+
+A device stage's window function is a ``win_kind``: a builtin combine
+name ('sum'/'count'/'mean'/'max'/'min'), a JAX callable
+``fn(gwid, cols, mask) -> value`` (the __host__ __device__ functor
+analogue, API:104-132), or for FFAT ops a (lift, combine[, neutral])
+pair with the combine either builtin or a JAX binary function.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ...core.basic import (OptLevel, OrderingMode, Pattern, Role,
+                           RoutingMode, WinOperatorConfig, WinType)
+from ...core.tuples import BasicRecord
+from ...core.win_assign import pane_length
+from ...runtime.emitters import StandardEmitter
+from ...runtime.win_routing import KFEmitter, WFEmitter, WidOrderCollector, \
+    WinMapEmitter
+from ..base import Operator, StageSpec
+from ..win_seq import WinSeqLogic
+from .win_seq_tpu import DEFAULT_BATCH_LEN, WinSeqTPULogic
+
+
+def _tpu_replicas(win_kind, win_len, slide_len, win_type, par, *,
+                  batch_len, triggering_delay, result_factory, value_of,
+                  enclosing: WinOperatorConfig, role: Role,
+                  farm_kind: str, renumbering=False):
+    """Build the worker set with the same config conventions as the CPU
+    farms (win_farm.hpp:175 / key_farm worker configs)."""
+    reps = []
+    for i in range(par):
+        if farm_kind == "wf":
+            cfg = WinOperatorConfig(enclosing.id_inner, enclosing.n_inner,
+                                    enclosing.slide_inner, i, par, slide_len)
+            slide = slide_len * par
+        elif farm_kind == "kf":
+            cfg = WinOperatorConfig(enclosing.id_inner, enclosing.n_inner,
+                                    enclosing.slide_inner, 0, 1, slide_len)
+            slide = slide_len
+        else:  # map stage / single engine
+            cfg = WinOperatorConfig(enclosing.id_inner, enclosing.n_inner,
+                                    enclosing.slide_inner, 0, 1, slide_len)
+            slide = slide_len
+        reps.append(WinSeqTPULogic(
+            win_kind, win_len, slide, win_type, batch_len=batch_len,
+            triggering_delay=triggering_delay, result_factory=result_factory,
+            config=cfg, role=role,
+            map_indexes=(i, par) if role == Role.MAP else (0, 1),
+            parallelism=par, replica_index=i, renumbering=renumbering,
+            value_of=value_of))
+    return reps
+
+
+class _TPUWinOp(Operator):
+    def __init__(self, name, parallelism, routing, pattern, win_type):
+        super().__init__(name, parallelism, routing, pattern)
+        self.win_type = win_type
+        self._renumbering = False
+
+    def enable_renumbering(self):
+        self._renumbering = True
+
+    def _ordering(self):
+        return (OrderingMode.ID if self.win_type == WinType.CB
+                else OrderingMode.TS)
+
+
+class KeyFarmTPU(_TPUWinOp):
+    def __init__(self, win_kind, win_len, slide_len, win_type,
+                 parallelism=1, batch_len=DEFAULT_BATCH_LEN,
+                 triggering_delay=0, name="key_farm_tpu",
+                 result_factory=BasicRecord, value_of=None,
+                 config: WinOperatorConfig = None):
+        super().__init__(name, parallelism, RoutingMode.KEYBY,
+                         Pattern.KEY_FARM_TPU, win_type)
+        self.args = (win_kind, win_len, slide_len, win_type)
+        self.batch_len = batch_len
+        self.triggering_delay = triggering_delay
+        self.result_factory = result_factory
+        self.value_of = value_of
+        self.config = config or WinOperatorConfig(0, 1, 0, 0, 1, 0)
+
+    def stages(self):
+        kind, win_len, slide_len, win_type = self.args
+        reps = _tpu_replicas(
+            kind, win_len, slide_len, win_type, self.parallelism,
+            batch_len=self.batch_len, triggering_delay=self.triggering_delay,
+            result_factory=self.result_factory, value_of=self.value_of,
+            enclosing=self.config, role=Role.SEQ, farm_kind="kf",
+            renumbering=self._renumbering)
+        return [StageSpec(self.name, reps, KFEmitter(self.parallelism),
+                          self.routing, ordering_mode=self._ordering())]
+
+
+class WinFarmTPU(_TPUWinOp):
+    def __init__(self, win_kind, win_len, slide_len, win_type,
+                 parallelism=1, batch_len=DEFAULT_BATCH_LEN,
+                 triggering_delay=0, name="win_farm_tpu",
+                 result_factory=BasicRecord, value_of=None, ordered=True,
+                 opt_level=OptLevel.LEVEL0,
+                 config: WinOperatorConfig = None, role: Role = Role.SEQ):
+        super().__init__(name, parallelism, RoutingMode.COMPLEX,
+                         Pattern.WIN_FARM_TPU, win_type)
+        self.args = (win_kind, win_len, slide_len, win_type)
+        self.batch_len = batch_len
+        self.triggering_delay = triggering_delay
+        self.result_factory = result_factory
+        self.value_of = value_of
+        self.ordered = ordered
+        self.opt_level = opt_level
+        self.config = config or WinOperatorConfig(0, 1, 0, 0, 1, 0)
+        self.role = role
+
+    def stages(self):
+        kind, win_len, slide_len, win_type = self.args
+        cfg = self.config
+        reps = _tpu_replicas(
+            kind, win_len, slide_len, win_type, self.parallelism,
+            batch_len=self.batch_len, triggering_delay=self.triggering_delay,
+            result_factory=self.result_factory, value_of=self.value_of,
+            enclosing=cfg, role=self.role, farm_kind="wf")
+        emitter = WFEmitter(win_len, slide_len, self.parallelism, win_type,
+                            self.role, id_outer=cfg.id_inner,
+                            n_outer=cfg.n_inner, slide_outer=cfg.slide_inner)
+        collector = (WidOrderCollector()
+                     if self.ordered and self.opt_level == OptLevel.LEVEL0
+                     else None)
+        return [StageSpec(self.name, reps, emitter, self.routing,
+                          ordering_mode=self._ordering(),
+                          collector=collector)]
+
+
+class PaneFarmTPU(_TPUWinOp):
+    """PLQ or WLQ on device (pane_farm_gpu.hpp:105-106): the device stage
+    takes a win_kind, the host stage a Python callable."""
+
+    def __init__(self, plq: Any, wlq: Any, win_len, slide_len, win_type,
+                 plq_parallelism=1, wlq_parallelism=1, plq_on_tpu=True,
+                 wlq_on_tpu=False, batch_len=DEFAULT_BATCH_LEN,
+                 triggering_delay=0, name="pane_farm_tpu",
+                 result_factory=BasicRecord, value_of=None, ordered=True,
+                 opt_level=OptLevel.LEVEL0):
+        super().__init__(name, plq_parallelism + wlq_parallelism,
+                         RoutingMode.COMPLEX, Pattern.PANE_FARM_TPU,
+                         win_type)
+        if plq_on_tpu == wlq_on_tpu:
+            raise ValueError(
+                "exactly one of PLQ/WLQ must run on device "
+                "(pane_farm_gpu.hpp constraint, API:134)")
+        self.plq = plq
+        self.wlq = wlq
+        self.win_len = win_len
+        self.slide_len = slide_len
+        self.plq_par = plq_parallelism
+        self.wlq_par = wlq_parallelism
+        self.plq_on_tpu = plq_on_tpu
+        self.batch_len = batch_len
+        self.triggering_delay = triggering_delay
+        self.result_factory = result_factory
+        self.value_of = value_of
+        self.ordered = ordered
+        self.opt_level = opt_level
+        self.pane_len = pane_length(win_len, slide_len)
+        self.config = WinOperatorConfig(0, 1, slide_len, 0, 1, slide_len)
+
+    def stages(self):
+        cfg = self.config
+        pane = self.pane_len
+        stages = []
+        # ---- PLQ ----
+        if self.plq_on_tpu:
+            reps = _tpu_replicas(
+                self.plq, pane, pane, self.win_type, self.plq_par,
+                batch_len=self.batch_len,
+                triggering_delay=self.triggering_delay,
+                result_factory=self.result_factory, value_of=self.value_of,
+                enclosing=cfg, role=Role.PLQ,
+                farm_kind="wf" if self.plq_par > 1 else "seq")
+            emitter = (WFEmitter(pane, pane, self.plq_par, self.win_type,
+                                 Role.PLQ)
+                       if self.plq_par > 1 else StandardEmitter())
+            stages.append(StageSpec(
+                f"{self.name}_plq", reps, emitter, RoutingMode.COMPLEX,
+                ordering_mode=self._ordering(),
+                collector=WidOrderCollector() if self.plq_par > 1 else None))
+        else:
+            from ..pane_farm import PaneFarm  # host PLQ stage via CPU engine
+            host = PaneFarm(self.plq, lambda *a: None, self.win_len,
+                            self.slide_len, self.win_type, self.plq_par, 1,
+                            self.triggering_delay,
+                            result_factory=self.result_factory,
+                            ordered=True)
+            stages.append(host.stages()[0])
+        # ---- WLQ: CB windows over dense pane ids ----
+        wlq_win = self.win_len // pane
+        wlq_slide = self.slide_len // pane
+        if not self.plq_on_tpu:  # WLQ on device
+            reps = _tpu_replicas(
+                self.wlq, wlq_win, wlq_slide, WinType.CB, self.wlq_par,
+                batch_len=self.batch_len, triggering_delay=0,
+                result_factory=self.result_factory, value_of=self.value_of,
+                enclosing=cfg, role=Role.WLQ,
+                farm_kind="wf" if self.wlq_par > 1 else "seq")
+            emitter = (WFEmitter(wlq_win, wlq_slide, self.wlq_par,
+                                 WinType.CB, Role.WLQ)
+                       if self.wlq_par > 1
+                       else StandardEmitter(keyed=True))
+            stages.append(StageSpec(
+                f"{self.name}_wlq", reps, emitter,
+                RoutingMode.COMPLEX if self.wlq_par > 1 else RoutingMode.KEYBY,
+                ordering_mode=OrderingMode.ID,
+                collector=(WidOrderCollector()
+                           if self.wlq_par > 1 and self.ordered else None)))
+        else:  # WLQ on host
+            if self.wlq_par > 1:
+                from ..win_farm import WinFarm
+                wlq = WinFarm(self.wlq, wlq_win, wlq_slide, WinType.CB,
+                              self.wlq_par, 0, False, f"{self.name}_wlq",
+                              self.result_factory, None, self.ordered,
+                              self.opt_level, WinOperatorConfig(
+                                  cfg.id_outer, cfg.n_outer, cfg.slide_outer,
+                                  cfg.id_inner, cfg.n_inner, cfg.slide_inner),
+                              Role.WLQ)
+                stages.extend(wlq.stages())
+            else:
+                logic = WinSeqLogic(
+                    self.wlq, wlq_win, wlq_slide, WinType.CB,
+                    result_factory=self.result_factory,
+                    config=WinOperatorConfig(cfg.id_inner, cfg.n_inner,
+                                             cfg.slide_inner, 0, 1,
+                                             wlq_slide),
+                    role=Role.WLQ)
+                stages.append(StageSpec(
+                    f"{self.name}_wlq", [logic], StandardEmitter(keyed=True),
+                    RoutingMode.KEYBY, ordering_mode=OrderingMode.ID))
+        return stages
+
+
+class WinMapReduceTPU(_TPUWinOp):
+    """MAP or REDUCE on device (win_mapreduce_gpu.hpp:109-110)."""
+
+    def __init__(self, map_stage: Any, reduce_stage: Any, win_len, slide_len,
+                 win_type, map_parallelism=2, reduce_parallelism=1,
+                 map_on_tpu=True, batch_len=DEFAULT_BATCH_LEN,
+                 triggering_delay=0, name="win_mr_tpu",
+                 result_factory=BasicRecord, value_of=None, ordered=True):
+        super().__init__(name, map_parallelism + reduce_parallelism,
+                         RoutingMode.COMPLEX, Pattern.WIN_MAPREDUCE_TPU,
+                         win_type)
+        self.map_stage = map_stage
+        self.reduce_stage = reduce_stage
+        self.win_len = win_len
+        self.slide_len = slide_len
+        self.map_par = map_parallelism
+        self.reduce_par = reduce_parallelism
+        self.map_on_tpu = map_on_tpu
+        self.batch_len = batch_len
+        self.triggering_delay = triggering_delay
+        self.result_factory = result_factory
+        self.value_of = value_of
+        self.ordered = ordered
+        self.config = WinOperatorConfig(0, 1, slide_len, 0, 1, slide_len)
+
+    def stages(self):
+        cfg = self.config
+        mp = self.map_par
+        stages = []
+        # ---- MAP ----
+        if self.map_on_tpu:
+            reps = []
+            for i in range(mp):
+                reps.append(WinSeqTPULogic(
+                    self.map_stage, self.win_len, self.slide_len,
+                    self.win_type, batch_len=self.batch_len,
+                    triggering_delay=self.triggering_delay,
+                    result_factory=self.result_factory,
+                    config=WinOperatorConfig(cfg.id_inner, cfg.n_inner,
+                                             cfg.slide_inner, 0, 1,
+                                             self.slide_len),
+                    role=Role.MAP, map_indexes=(i, mp), parallelism=mp,
+                    replica_index=i, value_of=self.value_of))
+        else:
+            reps = [WinSeqLogic(
+                self.map_stage, self.win_len, self.slide_len, self.win_type,
+                triggering_delay=self.triggering_delay,
+                result_factory=self.result_factory,
+                config=WinOperatorConfig(cfg.id_inner, cfg.n_inner,
+                                         cfg.slide_inner, 0, 1,
+                                         self.slide_len),
+                role=Role.MAP, map_indexes=(i, mp), parallelism=mp,
+                replica_index=i) for i in range(mp)]
+        stages.append(StageSpec(
+            f"{self.name}_map", reps, WinMapEmitter(mp, self.win_type),
+            RoutingMode.COMPLEX, ordering_mode=self._ordering(),
+            collector=WidOrderCollector()))
+        # ---- REDUCE: CB tumbling windows of mp partials ----
+        if self.map_on_tpu:  # reduce on host
+            logic = [WinSeqLogic(
+                self.reduce_stage, mp, mp, WinType.CB,
+                result_factory=self.result_factory,
+                config=WinOperatorConfig(cfg.id_inner, cfg.n_inner,
+                                         cfg.slide_inner, 0, 1, mp),
+                role=Role.REDUCE)]
+        else:  # reduce on device
+            logic = _tpu_replicas(
+                self.reduce_stage, mp, mp, WinType.CB, 1,
+                batch_len=self.batch_len, triggering_delay=0,
+                result_factory=self.result_factory, value_of=self.value_of,
+                enclosing=cfg, role=Role.REDUCE, farm_kind="seq")
+        stages.append(StageSpec(
+            f"{self.name}_reduce", logic, StandardEmitter(keyed=True),
+            RoutingMode.KEYBY, ordering_mode=OrderingMode.ID))
+        return stages
+
+
+def _ffat_kind(combine: Any):
+    """Normalize an FFAT combine spec to an engine kind."""
+    if isinstance(combine, str):
+        return combine  # builtin: scan / sparse-table paths
+    if isinstance(combine, tuple) and len(combine) == 2:
+        fn, neutral = combine
+        return ("ffat", fn, float(neutral))
+    raise ValueError("FFAT combine must be a builtin name or "
+                     "(jax_binary_fn, neutral) tuple")
+
+
+class WinSeqFFATTPU(_TPUWinOp):
+    """Lift on host, associative combine on the device FlatFAT
+    (win_seqffat_gpu.hpp)."""
+
+    def __init__(self, lift: Callable, combine: Any, win_len, slide_len,
+                 win_type, batch_len=DEFAULT_BATCH_LEN, triggering_delay=0,
+                 name="win_seqffat_tpu", result_factory=BasicRecord):
+        super().__init__(name, 1, RoutingMode.FORWARD,
+                         Pattern.WIN_SEQFFAT_TPU, win_type)
+        self.kind = _ffat_kind(combine)
+        self.lift = lift
+        self.args = (win_len, slide_len, win_type, batch_len,
+                     triggering_delay, result_factory)
+
+    def stages(self):
+        win_len, slide_len, win_type, batch_len, delay, rf = self.args
+        logic = WinSeqTPULogic(
+            self.kind, win_len, slide_len, win_type, batch_len=batch_len,
+            triggering_delay=delay, result_factory=rf, value_of=self.lift,
+            renumbering=self._renumbering)
+        return [StageSpec(self.name, [logic], StandardEmitter(),
+                          self.routing, ordering_mode=self._ordering())]
+
+
+class KeyFFATTPU(_TPUWinOp):
+    """Key-sharded device FFAT farm (key_ffat_gpu.hpp:18-35)."""
+
+    def __init__(self, lift: Callable, combine: Any, win_len, slide_len,
+                 win_type, parallelism=1, batch_len=DEFAULT_BATCH_LEN,
+                 triggering_delay=0, name="key_ffat_tpu",
+                 result_factory=BasicRecord):
+        super().__init__(name, parallelism, RoutingMode.KEYBY,
+                         Pattern.KEY_FFAT_TPU, win_type)
+        self.kind = _ffat_kind(combine)
+        self.lift = lift
+        self.args = (win_len, slide_len, win_type, batch_len,
+                     triggering_delay, result_factory)
+
+    def stages(self):
+        win_len, slide_len, win_type, batch_len, delay, rf = self.args
+        reps = [WinSeqTPULogic(
+            self.kind, win_len, slide_len, win_type, batch_len=batch_len,
+            triggering_delay=delay, result_factory=rf, value_of=self.lift,
+            config=WinOperatorConfig(0, 1, 0, 0, 1, slide_len),
+            parallelism=self.parallelism, replica_index=i,
+            renumbering=self._renumbering)
+            for i in range(self.parallelism)]
+        return [StageSpec(self.name, reps, KFEmitter(self.parallelism),
+                          self.routing, ordering_mode=self._ordering())]

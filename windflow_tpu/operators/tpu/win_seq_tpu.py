@@ -1,0 +1,311 @@
+"""Win_Seq_TPU: the device-batched keyed window engine.
+
+Re-design of reference ``wf/win_seq_gpu.hpp`` (769 LoC): where the
+reference archives tuples per key, batches ``batch_len`` fired windows,
+copies them to pinned buffers and launches a CUDA kernel per batch on a
+private stream (svc :391-645), this engine:
+
+* keeps each key's series in growing host buffers (consolidated into
+  sorted numpy arrays at flush time -- the pinned-staging analogue);
+* accumulates descriptors of fired windows (key, gwid, extent) until
+  ``batch_len``;
+* assembles one flat ragged buffer + [start, end) extents and launches
+  a jitted XLA program via `WindowComputeEngine` (ops/window_compute);
+* overlaps host batching with device execution through async dispatch,
+  flushing the *previous* batch's results lazily -- the double-buffered
+  ``waitAndFlush`` protocol (win_seq_gpu.hpp:267-297).
+
+Window-id assignment (config/role arithmetic) is identical to the host
+engine, so this operator drops into every composite farm exactly like
+Win_Seq_GPU does in the reference (win_farm_gpu.hpp:82-86).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ...core.basic import (OrderingMode, Pattern, Role, RoutingMode,
+                           WinOperatorConfig, WinType)
+from ...core.meta import default_hash
+from ...core.tuples import BasicRecord, TupleBatch
+from ...core import win_assign as wa
+from ...ops.window_compute import DeviceBatchHandle, WindowComputeEngine
+from ...runtime.emitters import StandardEmitter
+from ...runtime.node import EOSMarker, NodeLogic
+from ..base import Operator, StageSpec
+
+DEFAULT_BATCH_LEN = 256
+
+
+class _TPUKeyState:
+    __slots__ = ("sort_keys", "ts", "values", "pending_sort", "pending_ts",
+                 "pending_val", "next_fire", "opened_max", "max_id",
+                 "renumber_next", "emit_counter")
+
+    def __init__(self, emit_counter_start=0):
+        # consolidated sorted arrays
+        self.sort_keys = np.empty(0, np.int64)
+        self.ts = np.empty(0, np.int64)
+        self.values = np.empty(0, np.float64)
+        # unsorted pending appends (sorted at consolidation)
+        self.pending_sort: List[int] = []
+        self.pending_ts: List[int] = []
+        self.pending_val: List[float] = []
+        self.next_fire = 0        # next lwid to fire
+        self.opened_max = -1      # highest lwid opened by any tuple
+        self.max_id = -1
+        self.renumber_next = 0
+        self.emit_counter = emit_counter_start
+
+
+class WinSeqTPULogic(NodeLogic):
+    def __init__(self, win_kind: Any, win_len: int, slide_len: int,
+                 win_type: WinType, *, batch_len: int = DEFAULT_BATCH_LEN,
+                 triggering_delay: int = 0, result_factory=BasicRecord,
+                 config: WinOperatorConfig = None, role: Role = Role.SEQ,
+                 map_indexes=(0, 1), parallelism: int = 1,
+                 replica_index: int = 0, renumbering: bool = False,
+                 value_of: Callable[[Any], float] = None,
+                 closing_func: Callable = None):
+        if win_len == 0 or slide_len == 0:
+            raise ValueError("win_len and slide_len must be > 0")
+        self.engine = WindowComputeEngine(win_kind)
+        self.win_len = win_len
+        self.slide_len = slide_len
+        self.win_type = win_type
+        self.batch_len = max(1, batch_len)
+        self.triggering_delay = triggering_delay
+        self.result_factory = result_factory
+        self.config = config or WinOperatorConfig()
+        self.role = role
+        self.map_indexes = map_indexes
+        self.renumbering = renumbering
+        self.value_of = value_of or (lambda t: t.value)
+        self.closing_func = closing_func
+        self.keys: Dict[Any, _TPUKeyState] = {}
+        # batch under assembly: descriptors (key, gwid, start_key, end_key)
+        self.descriptors: List = []
+        # in-flight batch: (handle, descriptor list)
+        self.pending: Optional[tuple] = None
+        self.ignored_tuples = 0
+        self.launched_batches = 0
+
+    # -- per-key helpers ---------------------------------------------------
+    def _key_state(self, key) -> _TPUKeyState:
+        st = self.keys.get(key)
+        if st is None:
+            start = self.map_indexes[0] if self.role == Role.MAP else 0
+            st = self.keys[key] = _TPUKeyState(start)
+        return st
+
+    def _consolidate(self, st: _TPUKeyState) -> None:
+        if not st.pending_sort:
+            return
+        sk = np.asarray(st.pending_sort, np.int64)
+        ts = np.asarray(st.pending_ts, np.int64)
+        vals = np.asarray(st.pending_val, np.float64)
+        order = np.argsort(sk, kind="stable")
+        sk, ts, vals = sk[order], ts[order], vals[order]
+        if len(st.sort_keys) and len(sk) and sk[0] < st.sort_keys[-1]:
+            # out-of-order across consolidations (TB within delay): merge
+            merged = np.concatenate([st.sort_keys, sk])
+            order = np.argsort(merged, kind="stable")
+            st.sort_keys = merged[order]
+            st.ts = np.concatenate([st.ts, ts])[order]
+            st.values = np.concatenate([st.values, vals])[order]
+        else:
+            st.sort_keys = np.concatenate([st.sort_keys, sk])
+            st.ts = np.concatenate([st.ts, ts])
+            st.values = np.concatenate([st.values, vals])
+        st.pending_sort.clear()
+        st.pending_ts.clear()
+        st.pending_val.clear()
+
+    def _evict(self, st: _TPUKeyState, initial_id: int) -> None:
+        """Drop the prefix no window >= next_fire can reach (the archive
+        purge, win_seq_gpu.hpp:612-614)."""
+        keep_from = initial_id + st.next_fire * self.slide_len
+        cut = np.searchsorted(st.sort_keys, keep_from, side="left")
+        if cut:
+            st.sort_keys = st.sort_keys[cut:]
+            st.ts = st.ts[cut:]
+            st.values = st.values[cut:]
+
+    # -- batch plane -------------------------------------------------------
+    def _flush_pending(self, emit) -> None:
+        if self.pending is None:
+            return
+        handle, descs = self.pending
+        self.pending = None
+        results = handle.block()
+        for (key, gwid, _s, _e, rts, kd_key), val in zip(descs, results):
+            out = self.result_factory()
+            out.value = float(val)
+            out.set_control_fields(key, gwid, rts)
+            st = self.keys[kd_key]
+            if self.role == Role.MAP:
+                out.set_control_fields(key, st.emit_counter, rts)
+                st.emit_counter += self.map_indexes[1]
+            elif self.role == Role.PLQ:
+                new_id = wa.plq_renumbered_id(default_hash(key),
+                                              st.emit_counter, self.config)
+                out.set_control_fields(key, new_id, rts)
+                st.emit_counter += 1
+            emit(out)
+
+    def _launch(self, emit) -> None:
+        if not self.descriptors:
+            return
+        self._flush_pending(emit)  # waitAndFlush of the previous kernel
+        descs = self.descriptors
+        self.descriptors = []
+        # assemble the flat ragged buffer over the involved keys
+        keys_involved: List = []
+        seen = set()
+        for d in descs:
+            if d[5] not in seen:
+                seen.add(d[5])
+                keys_involved.append(d[5])
+        offsets = {}
+        bufs_v, bufs_t = [], []
+        off = 0
+        for k in keys_involved:
+            st = self.keys[k]
+            self._consolidate(st)
+            offsets[k] = off
+            bufs_v.append(st.values)
+            off += len(st.values)
+        flat_vals = (np.concatenate(bufs_v) if bufs_v
+                     else np.empty(0, np.float64))
+        starts = np.empty(len(descs), np.int64)
+        ends = np.empty(len(descs), np.int64)
+        gwids = np.empty(len(descs), np.int64)
+        for i, (k, gwid, s_key, e_key, rts, kd_key) in enumerate(descs):
+            st = self.keys[kd_key]
+            base = offsets[kd_key]
+            lo = int(np.searchsorted(st.sort_keys, s_key, "left"))
+            hi = int(np.searchsorted(st.sort_keys, e_key, "left"))
+            starts[i] = base + lo
+            ends[i] = base + hi
+            gwids[i] = gwid
+            if rts < 0:  # CB: ts of the most recent tuple in the window
+                descs[i] = (k, gwid, s_key, e_key,
+                            int(st.ts[hi - 1]) if hi > lo else 0, kd_key)
+        handle = self.engine.compute({"value": flat_vals}, starts, ends,
+                                     gwids)
+        self.pending = (handle, descs)
+        self.launched_batches += 1
+        # the flat buffer snapshot is on device now: evict consumed prefixes
+        for k in keys_involved:
+            st = self.keys[k]
+            self._evict(st, wa.initial_id_of_key(default_hash(k), self.config,
+                                                 self.role))
+
+    # -- descriptor generation (window assignment) -------------------------
+    def _fire_ready(self, key, st: _TPUKeyState, id_: int, hashcode: int,
+                    emit) -> None:
+        cfg = self.config
+        first_gwid = wa.first_gwid_of_key(hashcode, cfg)
+        initial_id = wa.initial_id_of_key(hashcode, cfg, self.role)
+        slack = self.triggering_delay if self.win_type == WinType.TB else 0
+        while True:
+            lwid = st.next_fire
+            start = initial_id + lwid * self.slide_len
+            end = start + self.win_len
+            # a window fires once a tuple beyond its extent (+delay) is seen
+            if st.max_id < end + slack or lwid > st.opened_max:
+                break
+            gwid = wa.gwid_of_lwid(first_gwid, lwid, cfg)
+            rts = (gwid * self.slide_len + self.win_len - 1
+                   if self.win_type == WinType.TB else -1)  # CB: at launch
+            self.descriptors.append((key, gwid, start, end, rts, key))
+            st.next_fire += 1
+            if len(self.descriptors) >= self.batch_len:
+                self._launch(emit)
+
+    def svc(self, item, channel_id, emit):
+        is_marker = isinstance(item, EOSMarker)
+        t = item.record if is_marker else item
+        key, tid, ts = t.get_control_fields()
+        hashcode = default_hash(key)
+        st = self._key_state(key)
+        if self.renumbering and not is_marker:
+            tid = st.renumber_next
+            st.renumber_next += 1
+            t.set_control_fields(key, tid, ts)
+        id_ = tid if self.win_type == WinType.CB else ts
+        cfg = self.config
+        initial_id = wa.initial_id_of_key(hashcode, cfg, self.role)
+        if not is_marker:
+            min_boundary = (self.win_len + (st.next_fire - 1) * self.slide_len
+                            if st.next_fire > 0 else 0)
+            if id_ < initial_id + min_boundary:
+                if st.next_fire > 0:
+                    self.ignored_tuples += 1
+                return
+            last_w = wa.last_window_of(id_, initial_id, self.win_len,
+                                       self.slide_len)
+            if last_w < 0:
+                return  # hopping gap
+            st.opened_max = max(st.opened_max, last_w)
+            st.pending_sort.append(id_)
+            st.pending_ts.append(ts)
+            st.pending_val.append(self.value_of(t))
+        st.max_id = max(st.max_id, id_)
+        self._fire_ready(key, st, id_, hashcode, emit)
+
+    def eos_flush(self, emit):
+        """Fire every opened window, then drain both batches (the
+        reference computes leftovers on CPU at EOS,
+        win_seq_gpu.hpp:648-710; we just launch a final batch)."""
+        for key, st in self.keys.items():
+            hashcode = default_hash(key)
+            cfg = self.config
+            first_gwid = wa.first_gwid_of_key(hashcode, cfg)
+            initial_id = wa.initial_id_of_key(hashcode, cfg, self.role)
+            for lwid in range(st.next_fire, st.opened_max + 1):
+                start = initial_id + lwid * self.slide_len
+                end = start + self.win_len
+                gwid = wa.gwid_of_lwid(first_gwid, lwid, cfg)
+                rts = (gwid * self.slide_len + self.win_len - 1
+                       if self.win_type == WinType.TB else 0)
+                self.descriptors.append((key, gwid, start, end, rts, key))
+                st.next_fire += 1
+                if len(self.descriptors) >= self.batch_len:
+                    self._launch(emit)
+        self._launch(emit)
+        self._flush_pending(emit)
+
+    def svc_end(self):
+        if self.closing_func is not None:
+            from ...core.context import RuntimeContext
+            self.closing_func(RuntimeContext())
+
+
+class WinSeqTPU(Operator):
+    """Standalone device-batched window operator (builders_gpu.hpp:50
+    analogue)."""
+
+    def __init__(self, win_kind, win_len, slide_len, win_type,
+                 batch_len=DEFAULT_BATCH_LEN, triggering_delay=0,
+                 name="win_seq_tpu", result_factory=BasicRecord,
+                 value_of=None, closing_func=None):
+        super().__init__(name, 1, RoutingMode.FORWARD, Pattern.WIN_SEQ_TPU)
+        self.win_type = win_type
+        self.kwargs = dict(
+            win_kind=win_kind, win_len=win_len, slide_len=slide_len,
+            win_type=win_type, batch_len=batch_len,
+            triggering_delay=triggering_delay, result_factory=result_factory,
+            value_of=value_of, closing_func=closing_func)
+        self._renumbering = False
+
+    def enable_renumbering(self):
+        self._renumbering = True
+
+    def stages(self):
+        logic = WinSeqTPULogic(renumbering=self._renumbering, **self.kwargs)
+        return [StageSpec(
+            self.name, [logic], StandardEmitter(), self.routing,
+            ordering_mode=(OrderingMode.ID if self.win_type == WinType.CB
+                           else OrderingMode.TS))]
