@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Benchmark: keyed sliding-window aggregation throughput (tuples/sec/chip).
+
+BASELINE.json metric: "tuples/sec/chip on keyed sliding-window
+aggregate".  The workload is config #2 (keyed sliding time-window sum on
+a synthetic source) on the columnar plane: BatchSource -> KeyFarmTPU
+(device-batched window sums, async double-buffered) -> counting sink.
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline``
+compares against the in-process reference-style engine: the same
+workload run through the record-at-a-time host Win_Seq path (the
+reference's CPU architecture re-created here), i.e. device-batched
+columnar plane vs FastFlow-style scalar plane on the same machine.
+
+Prints exactly one JSON line on stdout.
+"""
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+N_EVENTS = 8_000_000
+N_KEYS = 64
+WIN = 4096
+SLIDE = 2048
+SOURCE_BATCH = 131_072
+DEVICE_BATCH = 4096
+HOST_BASELINE_EVENTS = 400_000
+
+
+def run_tpu_graph(n_events, warmup=False):
+    import windflow_tpu as wf
+    from windflow_tpu.core.tuples import TupleBatch
+    from windflow_tpu.operators.batch_ops import BatchSource
+    from windflow_tpu.operators.basic_ops import Sink
+    from windflow_tpu.operators.tpu.farms_tpu import KeyFarmTPU
+
+    state = {"sent": 0}
+    rng = np.random.default_rng(7)
+
+    def source(ctx):
+        i = state["sent"]
+        if i >= n_events:
+            return None
+        n = min(SOURCE_BATCH, n_events - i)
+        ts = i + np.arange(n, dtype=np.int64)
+        batch = TupleBatch({
+            "key": ts % N_KEYS,
+            "id": ts // N_KEYS,
+            "ts": ts // N_KEYS,
+            "value": rng.random(n),
+        })
+        state["sent"] = i + n
+        return batch
+
+    got = {"windows": 0, "sum": 0.0}
+    lock = threading.Lock()
+
+    def sink(item):
+        if item is None:
+            return
+        with lock:
+            if isinstance(item, TupleBatch):
+                got["windows"] += len(item)
+                got["sum"] += float(item["value"].sum())
+            else:
+                got["windows"] += 1
+                got["sum"] += item.value
+
+    g = wf.PipeGraph("bench", wf.Mode.DEFAULT)
+    op = KeyFarmTPU("sum", WIN, SLIDE, wf.WinType.TB, parallelism=1,
+                    batch_len=DEVICE_BATCH, emit_batches=True)
+    g.add_source(BatchSource(source)).add(op).add_sink(Sink(sink))
+    t0 = time.perf_counter()
+    g.run()
+    dt = time.perf_counter() - t0
+    return n_events / dt, got["windows"], dt
+
+
+def run_host_baseline(n_events):
+    """Reference-architecture path: record-at-a-time host Win_Seq with
+    incremental update (the CPU engine every reference operator uses)."""
+    import windflow_tpu as wf
+    from windflow_tpu.core import BasicRecord
+
+    state = {"sent": 0}
+
+    def source(shipper, ctx):
+        i = state["sent"]
+        if i >= n_events:
+            return False
+        shipper.push(BasicRecord(i % N_KEYS, i // N_KEYS, i // N_KEYS,
+                                 float(i % 97)))
+        state["sent"] = i + 1
+        return True
+
+    count = {"n": 0}
+
+    def sink(rec):
+        if rec is not None:
+            count["n"] += 1
+
+    def upd(gwid, t, result):
+        result.value += t.value
+
+    g = wf.PipeGraph("baseline", wf.Mode.DEFAULT)
+    op = wf.KeyFarmBuilder(upd).with_incremental() \
+        .with_tb_windows(WIN, SLIDE).with_parallelism(1).build()
+    g.add_source(wf.SourceBuilder(source).build()) \
+        .add(op).add_sink(wf.SinkBuilder(sink).build())
+    t0 = time.perf_counter()
+    g.run()
+    dt = time.perf_counter() - t0
+    return n_events / dt
+
+
+def main():
+    # warmup: populate jit caches with the shapes the timed run uses
+    run_tpu_graph(min(1_000_000, N_EVENTS // 8), warmup=True)
+    rate, windows, dt = run_tpu_graph(N_EVENTS)
+    host_rate = run_host_baseline(HOST_BASELINE_EVENTS)
+    print(f"[bench] tpu: {rate:,.0f} tuples/s ({windows} windows in "
+          f"{dt:.2f}s); host reference-style: {host_rate:,.0f} tuples/s",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "keyed sliding-window aggregate throughput",
+        "value": round(rate, 1),
+        "unit": "tuples/sec/chip",
+        "vs_baseline": round(rate / host_rate, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -3,13 +3,19 @@
 Multi-chip TPU hardware is not available in CI; all sharding/collective
 tests run on XLA's host platform with 8 virtual devices (the driver
 separately dry-run-compiles the multi-chip path via __graft_entry__).
-Must run before the first jax import anywhere in the test process.
+
+The environment may pin JAX_PLATFORMS to a hardware plugin at
+interpreter start; ``jax.config.update`` after import takes precedence,
+and XLA_FLAGS must be set before the backend initializes (it does so
+lazily, so doing it here is early enough).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -46,6 +46,13 @@ class _TPUBuilderMixin:
 
     withValueOf = with_value_of
 
+    def with_batch_output(self, on: bool = True):
+        """Emit results as columnar TupleBatches (hot path)."""
+        self.emit_batches = on
+        return self
+
+    withBatchOutput = with_batch_output
+
 
 @_alias_camel
 class WinSeqTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
@@ -58,6 +65,7 @@ class WinSeqTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
         self.batch_len = DEFAULT_BATCH_LEN
         self.value_of = None
         self.device_index = 0
+        self.emit_batches = False
 
     def build(self) -> WinSeqTPU:
         self._check_windows()
@@ -65,7 +73,7 @@ class WinSeqTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                          self.win_type, self.batch_len,
                          self.triggering_delay, self.name,
                          self.result_factory, self.value_of,
-                         self.closing_func)
+                         self.closing_func, self.emit_batches)
 
 
 @_alias_camel
@@ -105,13 +113,15 @@ class KeyFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
         self.batch_len = DEFAULT_BATCH_LEN
         self.value_of = None
         self.device_index = 0
+        self.emit_batches = False
 
     def build(self) -> KeyFarmTPU:
         self._check_windows()
         return KeyFarmTPU(self.fn, self.win_len, self.slide_len,
                           self.win_type, self.parallelism, self.batch_len,
                           self.triggering_delay, self.name,
-                          self.result_factory, self.value_of)
+                          self.result_factory, self.value_of,
+                          emit_batches=self.emit_batches)
 
 
 @_alias_camel

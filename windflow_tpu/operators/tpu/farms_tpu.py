@@ -39,7 +39,7 @@ from .win_seq_tpu import DEFAULT_BATCH_LEN, WinSeqTPULogic
 def _tpu_replicas(win_kind, win_len, slide_len, win_type, par, *,
                   batch_len, triggering_delay, result_factory, value_of,
                   enclosing: WinOperatorConfig, role: Role,
-                  farm_kind: str, renumbering=False):
+                  farm_kind: str, renumbering=False, emit_batches=False):
     """Build the worker set with the same config conventions as the CPU
     farms (win_farm.hpp:175 / key_farm worker configs)."""
     reps = []
@@ -62,7 +62,7 @@ def _tpu_replicas(win_kind, win_len, slide_len, win_type, par, *,
             config=cfg, role=role,
             map_indexes=(i, par) if role == Role.MAP else (0, 1),
             parallelism=par, replica_index=i, renumbering=renumbering,
-            value_of=value_of))
+            value_of=value_of, emit_batches=emit_batches))
     return reps
 
 
@@ -85,7 +85,7 @@ class KeyFarmTPU(_TPUWinOp):
                  parallelism=1, batch_len=DEFAULT_BATCH_LEN,
                  triggering_delay=0, name="key_farm_tpu",
                  result_factory=BasicRecord, value_of=None,
-                 config: WinOperatorConfig = None):
+                 config: WinOperatorConfig = None, emit_batches=False):
         super().__init__(name, parallelism, RoutingMode.KEYBY,
                          Pattern.KEY_FARM_TPU, win_type)
         self.args = (win_kind, win_len, slide_len, win_type)
@@ -94,6 +94,7 @@ class KeyFarmTPU(_TPUWinOp):
         self.result_factory = result_factory
         self.value_of = value_of
         self.config = config or WinOperatorConfig(0, 1, 0, 0, 1, 0)
+        self.emit_batches = emit_batches
 
     def stages(self):
         kind, win_len, slide_len, win_type = self.args
@@ -102,7 +103,7 @@ class KeyFarmTPU(_TPUWinOp):
             batch_len=self.batch_len, triggering_delay=self.triggering_delay,
             result_factory=self.result_factory, value_of=self.value_of,
             enclosing=self.config, role=Role.SEQ, farm_kind="kf",
-            renumbering=self._renumbering)
+            renumbering=self._renumbering, emit_batches=self.emit_batches)
         return [StageSpec(self.name, reps, KFEmitter(self.parallelism),
                           self.routing, ordering_mode=self._ordering())]
 

@@ -40,18 +40,20 @@ DEFAULT_BATCH_LEN = 256
 
 class _TPUKeyState:
     __slots__ = ("sort_keys", "ts", "values", "pending_sort", "pending_ts",
-                 "pending_val", "next_fire", "opened_max", "max_id",
-                 "renumber_next", "emit_counter")
+                 "pending_val", "pending_chunks", "next_fire", "opened_max",
+                 "max_id", "renumber_next", "emit_counter")
 
     def __init__(self, emit_counter_start=0):
         # consolidated sorted arrays
         self.sort_keys = np.empty(0, np.int64)
         self.ts = np.empty(0, np.int64)
         self.values = np.empty(0, np.float64)
-        # unsorted pending appends (sorted at consolidation)
+        # unsorted pending appends (sorted at consolidation): scalar
+        # lists for the record plane, array chunks for the batch plane
         self.pending_sort: List[int] = []
         self.pending_ts: List[int] = []
         self.pending_val: List[float] = []
+        self.pending_chunks: List = []
         self.next_fire = 0        # next lwid to fire
         self.opened_max = -1      # highest lwid opened by any tuple
         self.max_id = -1
@@ -67,7 +69,7 @@ class WinSeqTPULogic(NodeLogic):
                  map_indexes=(0, 1), parallelism: int = 1,
                  replica_index: int = 0, renumbering: bool = False,
                  value_of: Callable[[Any], float] = None,
-                 closing_func: Callable = None):
+                 closing_func: Callable = None, emit_batches: bool = False):
         if win_len == 0 or slide_len == 0:
             raise ValueError("win_len and slide_len must be > 0")
         self.engine = WindowComputeEngine(win_kind)
@@ -83,6 +85,7 @@ class WinSeqTPULogic(NodeLogic):
         self.renumbering = renumbering
         self.value_of = value_of or (lambda t: t.value)
         self.closing_func = closing_func
+        self.emit_batches = emit_batches
         self.keys: Dict[Any, _TPUKeyState] = {}
         # batch under assembly: descriptors (key, gwid, start_key, end_key)
         self.descriptors: List = []
@@ -100,11 +103,19 @@ class WinSeqTPULogic(NodeLogic):
         return st
 
     def _consolidate(self, st: _TPUKeyState) -> None:
-        if not st.pending_sort:
+        if not st.pending_sort and not st.pending_chunks:
             return
-        sk = np.asarray(st.pending_sort, np.int64)
-        ts = np.asarray(st.pending_ts, np.int64)
-        vals = np.asarray(st.pending_val, np.float64)
+        chunks_sk = [c[0] for c in st.pending_chunks]
+        chunks_ts = [c[1] for c in st.pending_chunks]
+        chunks_v = [c[2] for c in st.pending_chunks]
+        if st.pending_sort:
+            chunks_sk.append(np.asarray(st.pending_sort, np.int64))
+            chunks_ts.append(np.asarray(st.pending_ts, np.int64))
+            chunks_v.append(np.asarray(st.pending_val, np.float64))
+        st.pending_chunks.clear()
+        sk = np.concatenate(chunks_sk)
+        ts = np.concatenate(chunks_ts)
+        vals = np.concatenate(chunks_v)
         order = np.argsort(sk, kind="stable")
         sk, ts, vals = sk[order], ts[order], vals[order]
         if len(st.sort_keys) and len(sk) and sk[0] < st.sort_keys[-1]:
@@ -139,6 +150,19 @@ class WinSeqTPULogic(NodeLogic):
         handle, descs = self.pending
         self.pending = None
         results = handle.block()
+        if self.emit_batches and self.role == Role.SEQ:
+            # columnar emission: one result TupleBatch per device batch
+            out = TupleBatch({
+                "key": np.fromiter((d[0] for d in descs), np.int64,
+                                   len(descs)),
+                "id": np.fromiter((d[1] for d in descs), np.int64,
+                                  len(descs)),
+                "ts": np.fromiter((d[4] for d in descs), np.int64,
+                                  len(descs)),
+                "value": np.asarray(results, np.float64),
+            })
+            emit(out)
+            return
         for (key, gwid, _s, _e, rts, kd_key), val in zip(descs, results):
             out = self.result_factory()
             out.value = float(val)
@@ -224,7 +248,60 @@ class WinSeqTPULogic(NodeLogic):
             if len(self.descriptors) >= self.batch_len:
                 self._launch(emit)
 
+    # -- columnar ingest (the zero-copy fast path: a whole TupleBatch is
+    # partitioned by key and appended per key vectorized; the analogue of
+    # the reference feeding batches straight from pinned staging) --------
+    def _svc_batch(self, batch: TupleBatch, emit):
+        keys = batch.key
+        ids = batch.id if self.win_type == WinType.CB else batch.ts
+        vals = batch["value"]
+        tss = batch.ts
+        order = np.argsort(keys, kind="stable")
+        keys_s, ids_s = keys[order], ids[order]
+        vals_s, tss_s = vals[order], tss[order]
+        uniq, starts_idx = np.unique(keys_s, return_index=True)
+        bounds = np.append(starts_idx, len(keys_s))
+        cfg = self.config
+        for j, key in enumerate(uniq):
+            key = key.item()
+            lo, hi = bounds[j], bounds[j + 1]
+            st = self._key_state(key)
+            hashcode = default_hash(key)
+            initial_id = wa.initial_id_of_key(hashcode, cfg, self.role)
+            k_ids = ids_s[lo:hi]
+            if self.renumbering:
+                k_ids = np.arange(st.renumber_next,
+                                  st.renumber_next + (hi - lo))
+                st.renumber_next += hi - lo
+            # acceptance: drop tuples behind the already-fired frontier
+            min_boundary = (self.win_len + (st.next_fire - 1) * self.slide_len
+                            if st.next_fire > 0 else 0)
+            keep = k_ids >= initial_id + min_boundary
+            if self.win_len < self.slide_len:  # hopping: drop gap tuples
+                n = (k_ids - initial_id) // self.slide_len
+                off = k_ids - initial_id
+                keep &= (off >= n * self.slide_len) & \
+                    (off < n * self.slide_len + self.win_len)
+            n_drop = int((~keep).sum())
+            if n_drop and st.next_fire > 0:
+                self.ignored_tuples += n_drop
+            k_ids = k_ids[keep]
+            if len(k_ids) == 0:
+                continue
+            st.pending_chunks.append(
+                (k_ids.astype(np.int64), tss_s[lo:hi][keep],
+                 vals_s[lo:hi][keep].astype(np.float64)))
+            st.max_id = max(st.max_id, int(k_ids.max()))
+            last_w = wa.last_window_of(st.max_id, initial_id, self.win_len,
+                                       self.slide_len)
+            if last_w >= 0:
+                st.opened_max = max(st.opened_max, last_w)
+            self._fire_ready(key, st, st.max_id, hashcode, emit)
+
     def svc(self, item, channel_id, emit):
+        if isinstance(item, TupleBatch):
+            self._svc_batch(item, emit)
+            return
         is_marker = isinstance(item, EOSMarker)
         t = item.record if is_marker else item
         key, tid, ts = t.get_control_fields()
@@ -290,14 +367,15 @@ class WinSeqTPU(Operator):
     def __init__(self, win_kind, win_len, slide_len, win_type,
                  batch_len=DEFAULT_BATCH_LEN, triggering_delay=0,
                  name="win_seq_tpu", result_factory=BasicRecord,
-                 value_of=None, closing_func=None):
+                 value_of=None, closing_func=None, emit_batches=False):
         super().__init__(name, 1, RoutingMode.FORWARD, Pattern.WIN_SEQ_TPU)
         self.win_type = win_type
         self.kwargs = dict(
             win_kind=win_kind, win_len=win_len, slide_len=slide_len,
             win_type=win_type, batch_len=batch_len,
             triggering_delay=triggering_delay, result_factory=result_factory,
-            value_of=value_of, closing_func=closing_func)
+            value_of=value_of, closing_func=closing_func,
+            emit_batches=emit_batches)
         self._renumbering = False
 
     def enable_renumbering(self):

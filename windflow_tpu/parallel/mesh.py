@@ -1,0 +1,47 @@
+"""Device-mesh helpers for multi-chip execution.
+
+The reference has no network backend at all -- its fabric is FastFlow
+queues in one process (SURVEY.md §5 last bullet).  windflow_tpu scales
+past one chip the TPU way: a ``jax.sharding.Mesh`` with named axes,
+shardings annotated per array, and XLA inserting the collectives over
+ICI/DCN.  Axis conventions used throughout:
+
+* ``key``  -- key-shard axis: per-key window state is sharded by key
+  hash (the Key_Farm / Key_FFAT distribution, ≈ data parallelism);
+* ``win``  -- intra-window axis: one window's tuples are striped and
+  partials psum-combined (the Win_MapReduce distribution, ≈
+  tensor/sequence parallelism).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_names: Tuple[str, str] = ("key", "win"),
+              win_axis: int = 1):
+    """Build a 2-D ('key', 'win') mesh over the available devices.
+
+    ``win_axis`` chips cooperate on each window (psum over 'win'); the
+    remaining devices shard the key space.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if n % win_axis != 0:
+        raise ValueError(f"{n} devices not divisible by win_axis={win_axis}")
+    arr = np.array(devices).reshape(n // win_axis, win_axis)
+    return Mesh(arr, axis_names)
+
+
+def key_sharding(mesh, rank: int = 1):
+    """NamedSharding placing axis 0 on 'key' (per-key state layout)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = P("key", *([None] * (rank - 1)))
+    return NamedSharding(mesh, spec)

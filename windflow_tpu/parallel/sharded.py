@@ -1,0 +1,156 @@
+"""Multi-chip sharded window aggregation: the distributed execution plane.
+
+This is the TPU-native replacement for scaling strategies the reference
+implements as thread farms (SURVEY.md §2.4), mapped onto a
+('key', 'win') mesh:
+
+* **Key_Farm / Key_FFAT across chips** (BASELINE config #4): per-key
+  series and window state are sharded over the 'key' axis; each shard
+  runs the same batched window program locally; no cross-chip traffic
+  in steady state (keys are independent) -- like data parallelism.
+* **Win_MapReduce across chips** (BASELINE config #5): each window's
+  tuples are striped over the 'win' axis; every chip computes a stripe
+  partial and the window result is a ``psum`` over 'win' riding ICI --
+  like tensor/sequence parallelism.
+* **Pane_Farm across chips** (BASELINE config #3): chips hold
+  consecutive time-chunks; pane partials are computed locally and
+  window combines read neighbour panes via ``all_gather`` over 'win' --
+  the two-level blockwise reduction.
+
+Everything is expressed with ``shard_map`` over a Mesh so XLA lowers the
+collectives; the host runtime feeds per-shard batches (one WinSeqTPU
+replica per shard keeps the batching protocol unchanged).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Tuple
+
+import numpy as np
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_programs(mesh_id: int, win_len: int, slide_len: int):
+    """Build the jitted multi-chip streaming step for a given mesh.
+
+    Returns ``step(values, starts, ends, stripe_values, pane_values)``
+    computing, in one compiled program:
+      1. key-sharded sliding-window sums     [K_shards, B]    (KF path)
+      2. psum-combined striped window sums   [B2]             (WMR path)
+      3. pane partials + gathered window combine              (PF path)
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        # check_vma off: outputs replicated via collectives (all_gather/
+        # psum) that the static replication checker cannot always infer
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+
+    mesh = _MESHES[mesh_id]
+
+    def kf_shard(values, starts, ends):
+        # [1, T] values, [1, B] extents on this shard
+        c = jnp.concatenate([jnp.zeros((1, 1), values.dtype),
+                             jnp.cumsum(values, axis=1)], axis=1)
+        return jnp.take_along_axis(c, ends, axis=1) - \
+            jnp.take_along_axis(c, starts, axis=1)
+
+    def wmr_shard(stripe):
+        # [K_loc, 1, B2, W_stripe]: sum own stripe then psum over 'win'
+        partial = jnp.sum(stripe, axis=-1)
+        return jax.lax.psum(partial, "win")
+
+    def pf_shard(pane_vals):
+        # [K_loc, 1, P_loc, pane]: local pane partials (PLQ), then the
+        # full pane timeline via all_gather over 'win' (WLQ input)
+        partials = jnp.sum(pane_vals, axis=-1)          # [K_loc, 1, P_loc]
+        allp = jax.lax.all_gather(partials, "win", axis=1, tiled=True)
+        return allp.reshape(allp.shape[0], -1)           # [K_loc, P_tot]
+
+    kf = shard_map(kf_shard, mesh=mesh,
+                   in_specs=(P("key", None), P("key", None), P("key", None)),
+                   out_specs=P("key", None))
+
+    wmr = shard_map(wmr_shard, mesh=mesh,
+                    in_specs=(P("key", "win", None, None),),
+                    out_specs=P("key", None, None))
+
+    pf = shard_map(pf_shard, mesh=mesh,
+                   in_specs=(P("key", "win", None, None),),
+                   out_specs=P("key", None))
+
+    @jax.jit
+    def step(values, starts, ends, stripe_values, pane_values):
+        kf_out = kf(values, starts, ends)
+        wmr_out = wmr(stripe_values)
+        pane_partials = pf(pane_values)
+        # WLQ: combine panes into sliding windows on the gathered axis
+        pane_len = pane_values.shape[-1]
+        wpp = max(1, win_len // pane_len)   # panes per window
+        spp = max(1, slide_len // pane_len)  # panes per slide
+        n_windows = max(1, (pane_partials.shape[1] - wpp) // spp + 1)
+        idx = (jnp.arange(n_windows)[:, None] * spp
+               + jnp.arange(wpp)[None, :])
+        pf_out = jnp.sum(pane_partials[:, idx], axis=-1)
+        return kf_out, wmr_out, pf_out
+
+    return step
+
+
+_MESHES: Dict[int, Any] = {}
+
+
+class ShardedWindowEngine:
+    """Key-sharded multi-chip window engine (the distributed twin of
+    WindowComputeEngine).  Holds the mesh; each call runs the full
+    sharded step (KF + WMR + PF paths) as one XLA program with
+    collectives over ICI."""
+
+    def __init__(self, mesh, win_len: int, slide_len: int):
+        self.mesh = mesh
+        self.win_len = win_len
+        self.slide_len = slide_len
+        mesh_id = id(mesh)
+        _MESHES[mesh_id] = mesh
+        self._step = _sharded_programs(mesh_id, win_len, slide_len)
+
+    @property
+    def n_key_shards(self) -> int:
+        return self.mesh.shape["key"]
+
+    @property
+    def n_win_shards(self) -> int:
+        return self.mesh.shape["win"]
+
+    def step(self, values, starts, ends, stripe_values, pane_values):
+        """One sharded streaming step; see _sharded_programs."""
+        return self._step(values, starts, ends, stripe_values, pane_values)
+
+    def example_inputs(self, T: int = 64, B: int = 8, keys_per_shard: int = 2,
+                       stripe_w: int = 8, panes_per_shard: int = 4,
+                       pane_len: int = 4):
+        """Tiny correctly-sharded inputs for compile checks/dry runs."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        K = self.n_key_shards
+        W = self.n_win_shards
+        rng = np.random.default_rng(0)
+        values = rng.normal(size=(K, T)).astype(np.float32)
+        starts = np.tile(np.arange(B, dtype=np.int32) * 4, (K, 1))
+        ends = starts + np.int32(self.win_len)
+        stripe = rng.normal(
+            size=(K * keys_per_shard, W, B, stripe_w)).astype(np.float32)
+        pane = rng.normal(
+            size=(K * keys_per_shard, W, panes_per_shard,
+                  pane_len)).astype(np.float32)
+        dev = lambda x, spec: jax.device_put(
+            x, NamedSharding(self.mesh, spec))
+        return (dev(values, P("key", None)),
+                dev(starts, P("key", None)),
+                dev(ends, P("key", None)),
+                dev(stripe, P("key", "win", None, None)),
+                dev(pane, P("key", "win", None, None)))

@@ -43,8 +43,19 @@ class StandardEmitter(Emitter):
         self._rr = 0
 
     def emit(self, item, send_to):
+        from ..core.tuples import TupleBatch
         if self.n_dest == 1:
             send_to(0, item)
+        elif isinstance(item, TupleBatch):
+            if not self.keyed:
+                send_to(self._rr, item)  # whole-batch round robin
+                self._rr = (self._rr + 1) % self.n_dest
+            else:
+                # vectorized KEYBY: partition the batch by key hash
+                import numpy as np
+                dests = np.abs(item.key) % self.n_dest
+                for d in np.unique(dests):
+                    send_to(int(d), item.take(dests == d))
         elif self.keyed:
             rec = item.record if isinstance(item, EOSMarker) else item
             send_to(default_hash(self.key_of(rec)) % self.n_dest, item)

@@ -101,6 +101,13 @@ class KFEmitter(Emitter):
         self.routing = routing or (lambda h, n: h % n)
 
     def emit(self, item, send_to):
+        from ..core.tuples import TupleBatch
+        if isinstance(item, TupleBatch):
+            import numpy as np
+            dests = np.abs(item.key) % self.pardegree
+            for d in np.unique(dests):
+                send_to(int(d), item.take(dests == d))
+            return
         rec = item.record if isinstance(item, EOSMarker) else item
         key = rec.get_control_fields()[0]
         send_to(self.routing(default_hash(key), self.pardegree), item)
