@@ -25,8 +25,8 @@ N_EVENTS = 8_000_000
 N_KEYS = 64
 WIN = 4096
 SLIDE = 2048
-SOURCE_BATCH = 131_072
-DEVICE_BATCH = 4096
+SOURCE_BATCH = 262_144
+DEVICE_BATCH = 8192
 HOST_BASELINE_EVENTS = 400_000
 
 

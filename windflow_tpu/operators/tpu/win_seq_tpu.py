@@ -69,7 +69,8 @@ class WinSeqTPULogic(NodeLogic):
                  map_indexes=(0, 1), parallelism: int = 1,
                  replica_index: int = 0, renumbering: bool = False,
                  value_of: Callable[[Any], float] = None,
-                 closing_func: Callable = None, emit_batches: bool = False):
+                 closing_func: Callable = None, emit_batches: bool = False,
+                 max_buffer_elems: int = 1 << 19):
         if win_len == 0 or slide_len == 0:
             raise ValueError("win_len and slide_len must be > 0")
         self.engine = WindowComputeEngine(win_kind)
@@ -93,6 +94,12 @@ class WinSeqTPULogic(NodeLogic):
         self.pending: Optional[tuple] = None
         self.ignored_tuples = 0
         self.launched_batches = 0
+        # launch also when this much unshipped data is buffered, even if
+        # the window batch is not full -- bounds host memory and keeps
+        # device transfers pipelined (the adaptive resize analogue,
+        # win_seq_gpu.hpp:574-592)
+        self.max_buffer_elems = max_buffer_elems
+        self._buffered_since_launch = 0
 
     # -- per-key helpers ---------------------------------------------------
     def _key_state(self, key) -> _TPUKeyState:
@@ -178,48 +185,100 @@ class WinSeqTPULogic(NodeLogic):
                 st.emit_counter += 1
             emit(out)
 
+    # builtin associative kinds whose pane partials the host can
+    # pre-reduce before shipping (the Pane_Farm decomposition, applied
+    # as a transport optimization: ship partials, not tuples)
+    _PANE_KINDS = {"sum": "sum", "count": "sum", "max": "max", "min": "min"}
+
+    def _pane_partials(self, st: _TPUKeyState, base_key: int, n_panes: int,
+                       pane: int, kind: str):
+        """Per-pane host pre-reduction over one key's retained series."""
+        edges = base_key + np.arange(n_panes + 1, dtype=np.int64) * pane
+        pos = np.searchsorted(st.sort_keys, edges)
+        if kind == "count":
+            return np.diff(pos).astype(np.float64)
+        if kind == "sum":
+            cs = np.concatenate([[0.0], np.cumsum(st.values)])
+            return cs[pos[1:]] - cs[pos[:-1]]
+        neutral = -np.inf if kind == "max" else np.inf
+        ufunc = np.maximum if kind == "max" else np.minimum
+        safe = np.minimum(pos[:-1], max(len(st.values) - 1, 0))
+        if len(st.values) == 0:
+            return np.full(n_panes, neutral)
+        red = ufunc.reduceat(st.values, safe)
+        return np.where(np.diff(pos) > 0, red, neutral)
+
     def _launch(self, emit) -> None:
         if not self.descriptors:
             return
         self._flush_pending(emit)  # waitAndFlush of the previous kernel
         descs = self.descriptors
         self.descriptors = []
-        # assemble the flat ragged buffer over the involved keys
+        # group descriptors per key (preserving order)
         keys_involved: List = []
-        seen = set()
-        for d in descs:
-            if d[5] not in seen:
-                seen.add(d[5])
+        per_key: Dict = {}
+        for i, d in enumerate(descs):
+            if d[5] not in per_key:
+                per_key[d[5]] = []
                 keys_involved.append(d[5])
-        offsets = {}
-        bufs_v, bufs_t = [], []
+            per_key[d[5]].append(i)
+        pane = int(np.gcd(self.win_len, self.slide_len))
+        kind = self.engine.kind
+        use_panes = (isinstance(kind, str) and kind in self._PANE_KINDS
+                     and pane >= 16)
+        starts = np.empty(len(descs), np.int64)
+        ends = np.empty(len(descs), np.int64)
+        gwids = np.fromiter((d[1] for d in descs), np.int64, len(descs))
+        bufs_v = []
         off = 0
         for k in keys_involved:
             st = self.keys[k]
             self._consolidate(st)
-            offsets[k] = off
-            bufs_v.append(st.values)
-            off += len(st.values)
+            idxs = per_key[k]
+            if use_panes:
+                # window extents are pane-aligned (pane = gcd(win, slide)
+                # divides both the slide stride and the window length)
+                base_key = min(descs[i][2] for i in idxs)
+                max_end = max(descs[i][3] for i in idxs)
+                n_panes = (max_end - base_key) // pane
+                bufs_v.append(self._pane_partials(st, base_key, n_panes,
+                                                  pane, kind))
+                for i in idxs:
+                    starts[i] = off + (descs[i][2] - base_key) // pane
+                    ends[i] = off + (descs[i][3] - base_key) // pane
+                off += n_panes
+            else:
+                bufs_v.append(st.values)
+                for i in idxs:
+                    starts[i] = off + np.searchsorted(st.sort_keys,
+                                                      descs[i][2], "left")
+                    ends[i] = off + np.searchsorted(st.sort_keys,
+                                                    descs[i][3], "left")
+                off += len(st.values)
+            for i in idxs:  # CB: result ts = last tuple in extent
+                if descs[i][4] < 0:
+                    hi = int(np.searchsorted(st.sort_keys, descs[i][3],
+                                             "left"))
+                    lo = int(np.searchsorted(st.sort_keys, descs[i][2],
+                                             "left"))
+                    d = descs[i]
+                    descs[i] = (d[0], d[1], d[2], d[3],
+                                int(st.ts[hi - 1]) if hi > lo else 0, d[5])
         flat_vals = (np.concatenate(bufs_v) if bufs_v
                      else np.empty(0, np.float64))
-        starts = np.empty(len(descs), np.int64)
-        ends = np.empty(len(descs), np.int64)
-        gwids = np.empty(len(descs), np.int64)
-        for i, (k, gwid, s_key, e_key, rts, kd_key) in enumerate(descs):
-            st = self.keys[kd_key]
-            base = offsets[kd_key]
-            lo = int(np.searchsorted(st.sort_keys, s_key, "left"))
-            hi = int(np.searchsorted(st.sort_keys, e_key, "left"))
-            starts[i] = base + lo
-            ends[i] = base + hi
-            gwids[i] = gwid
-            if rts < 0:  # CB: ts of the most recent tuple in the window
-                descs[i] = (k, gwid, s_key, e_key,
-                            int(st.ts[hi - 1]) if hi > lo else 0, kd_key)
-        handle = self.engine.compute({"value": flat_vals}, starts, ends,
-                                     gwids)
+        eng = self.engine
+        if use_panes and kind == "count":
+            eng = self._count_engine()
+        handle = eng.compute({"value": flat_vals}, starts, ends, gwids)
         self.pending = (handle, descs)
         self.launched_batches += 1
+        self._buffered_since_launch = 0
+
+    def _count_engine(self):
+        # count over panes = sum of per-pane counts
+        if not hasattr(self, "_count_eng"):
+            self._count_eng = WindowComputeEngine("sum")
+        return self._count_eng
         # the flat buffer snapshot is on device now: evict consumed prefixes
         for k in keys_involved:
             st = self.keys[k]
@@ -259,8 +318,11 @@ class WinSeqTPULogic(NodeLogic):
         order = np.argsort(keys, kind="stable")
         keys_s, ids_s = keys[order], ids[order]
         vals_s, tss_s = vals[order], tss[order]
-        uniq, starts_idx = np.unique(keys_s, return_index=True)
-        bounds = np.append(starts_idx, len(keys_s))
+        # group boundaries on the sorted key column (cheaper than
+        # np.unique: one diff over the sorted array)
+        edges = np.nonzero(np.diff(keys_s))[0] + 1
+        bounds = np.concatenate([[0], edges, [len(keys_s)]])
+        uniq = keys_s[bounds[:-1]]
         cfg = self.config
         for j, key in enumerate(uniq):
             key = key.item()
@@ -291,12 +353,16 @@ class WinSeqTPULogic(NodeLogic):
             st.pending_chunks.append(
                 (k_ids.astype(np.int64), tss_s[lo:hi][keep],
                  vals_s[lo:hi][keep].astype(np.float64)))
+            self._buffered_since_launch += len(k_ids)
             st.max_id = max(st.max_id, int(k_ids.max()))
             last_w = wa.last_window_of(st.max_id, initial_id, self.win_len,
                                        self.slide_len)
             if last_w >= 0:
                 st.opened_max = max(st.opened_max, last_w)
             self._fire_ready(key, st, st.max_id, hashcode, emit)
+        if (self.descriptors
+                and self._buffered_since_launch >= self.max_buffer_elems):
+            self._launch(emit)
 
     def svc(self, item, channel_id, emit):
         if isinstance(item, TupleBatch):
