@@ -21,7 +21,9 @@ import time
 
 import numpy as np
 
-N_EVENTS = 8_000_000
+N_EVENTS = 16_000_000
+KEY_PARALLELISM = 8
+SOURCE_PARALLELISM = 2
 N_KEYS = 64
 WIN = 4096
 SLIDE = 2048
@@ -37,22 +39,25 @@ def run_tpu_graph(n_events, warmup=False):
     from windflow_tpu.operators.basic_ops import Sink
     from windflow_tpu.operators.tpu.farms_tpu import KeyFarmTPU
 
-    state = {"sent": 0}
-    rng = np.random.default_rng(7)
+    state = {}
 
     def source(ctx):
-        i = state["sent"]
-        if i >= n_events:
+        ridx = ctx.get_replica_index()
+        st = state.setdefault(ridx, {"sent": 0,
+                                     "rng": np.random.default_rng(ridx)})
+        i = st["sent"]
+        share = n_events // SOURCE_PARALLELISM
+        if i >= share:
             return None
-        n = min(SOURCE_BATCH, n_events - i)
+        n = min(SOURCE_BATCH, share - i)
         ts = i + np.arange(n, dtype=np.int64)
         batch = TupleBatch({
-            "key": ts % N_KEYS,
+            "key": (ts + 7 * ridx) % N_KEYS,
             "id": ts // N_KEYS,
             "ts": ts // N_KEYS,
-            "value": rng.random(n),
+            "value": st["rng"].random(n),
         })
-        state["sent"] = i + n
+        st["sent"] = i + n
         return batch
 
     got = {"windows": 0, "sum": 0.0}
@@ -70,9 +75,11 @@ def run_tpu_graph(n_events, warmup=False):
                 got["sum"] += item.value
 
     g = wf.PipeGraph("bench", wf.Mode.DEFAULT)
-    op = KeyFarmTPU("sum", WIN, SLIDE, wf.WinType.TB, parallelism=1,
-                    batch_len=DEVICE_BATCH, emit_batches=True)
-    g.add_source(BatchSource(source)).add(op).add_sink(Sink(sink))
+    op = KeyFarmTPU("sum", WIN, SLIDE, wf.WinType.TB,
+                    parallelism=KEY_PARALLELISM, batch_len=DEVICE_BATCH,
+                    emit_batches=True)
+    g.add_source(BatchSource(source, SOURCE_PARALLELISM)) \
+        .add(op).add_sink(Sink(sink))
     t0 = time.perf_counter()
     g.run()
     dt = time.perf_counter() - t0
