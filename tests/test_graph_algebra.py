@@ -1,0 +1,144 @@
+"""Split / merge graph-algebra tests.
+
+Mirrors tests/graph_tests, tests/split_tests, tests/merge_tests
+(SURVEY.md §4): complex DAGs combining split + merge, verified by
+aggregate oracles.
+"""
+import threading
+
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core import BasicRecord, Mode
+
+
+def source_fn(n):
+    state = {}
+
+    def fn(shipper, ctx):
+        i = state.setdefault("i", 0)
+        if i >= n:
+            return False
+        shipper.push(BasicRecord(i % 4, i // 4, i, float(i)))
+        state["i"] = i + 1
+        return True
+
+    return fn
+
+
+class SumSink:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.total = 0.0
+        self.count = 0
+
+    def __call__(self, rec):
+        if rec is not None:
+            with self.lock:
+                self.total += rec.value
+                self.count += 1
+
+
+def test_split_two_branches():
+    """Even values to branch 0, odd to branch 1 (split_tests style)."""
+    n = 100
+    s0, s1 = SumSink(), SumSink()
+    g = wf.PipeGraph("split", Mode.DEFAULT)
+    pipe = g.add_source(wf.SourceBuilder(source_fn(n)).build())
+    pipe.split(lambda t: int(t.value) % 2, 2)
+    pipe.select(0).add_sink(wf.SinkBuilder(s0).build())
+    pipe.select(1).add_sink(wf.SinkBuilder(s1).build())
+    g.run()
+    assert s0.total == sum(v for v in range(n) if v % 2 == 0)
+    assert s1.total == sum(v for v in range(n) if v % 2 == 1)
+
+
+def test_split_multi_destination():
+    """Splitting fn may return several branches (API:165-172)."""
+    n = 60
+    sinks = [SumSink() for _ in range(3)]
+    g = wf.PipeGraph("split3", Mode.DEFAULT)
+    pipe = g.add_source(wf.SourceBuilder(source_fn(n)).build())
+
+    def route(t):
+        if int(t.value) % 3 == 0:
+            return [0, 2]  # broadcast to two branches
+        return int(t.value) % 3
+
+    pipe.split(route, 3)
+    for i in range(3):
+        pipe.select(i).add_sink(wf.SinkBuilder(sinks[i]).build())
+    g.run()
+    third = sum(v for v in range(n) if v % 3 == 0)
+    assert sinks[0].total == third
+    assert sinks[1].total == sum(v for v in range(n) if v % 3 == 1)
+    assert sinks[2].total == sum(v for v in range(n) if v % 3 == 2) + third
+
+
+def test_merge_two_pipes():
+    """Merge two sourced pipes into one sink (merge_tests style)."""
+    sink = SumSink()
+    g = wf.PipeGraph("merge", Mode.DEFAULT)
+    p1 = g.add_source(wf.SourceBuilder(source_fn(50)).build())
+    p2 = g.add_source(wf.SourceBuilder(source_fn(30)).build())
+    merged = p1.merge(p2)
+    merged.add_sink(wf.SinkBuilder(sink).build())
+    g.run()
+    assert sink.total == sum(range(50)) + sum(range(30))
+    assert sink.count == 80
+
+
+def test_merge_then_window():
+    """Merged streams feed a keyed window operator (graph_tests style)."""
+    results = []
+    lock = threading.Lock()
+
+    def snk(rec):
+        if rec is not None:
+            with lock:
+                results.append(rec.value)
+
+    def sum_win(gwid, it, result):
+        result.value = sum(t.value for t in it)
+
+    # DETERMINISTIC: the two merged streams interleave out of order per
+    # key; ordering collectors restore ts order before the window engine
+    g = wf.PipeGraph("mw", Mode.DETERMINISTIC)
+    p1 = g.add_source(wf.SourceBuilder(source_fn(40)).build())
+    p2 = g.add_source(wf.SourceBuilder(source_fn(40)).build())
+    merged = p1.merge(p2)
+    merged.add(wf.KeyFarmBuilder(sum_win).with_parallelism(2)
+               .with_tb_windows(5, 5).build())
+    merged.add_sink(wf.SinkBuilder(snk).build())
+    g.run()
+    # every tuple lands in exactly one tumbling window: global sum doubles
+    assert sum(results) == 2 * sum(range(40))
+
+
+def test_split_then_merge():
+    """Diamond: split into 2 branches, process, re-merge (graph_tests
+    test_graph_* topologies)."""
+    sink = SumSink()
+    g = wf.PipeGraph("diamond", Mode.DEFAULT)
+    pipe = g.add_source(wf.SourceBuilder(source_fn(100)).build())
+    pipe.split(lambda t: int(t.value) % 2, 2)
+
+    def double(t):
+        t.value *= 2.0
+
+    b0 = pipe.select(0)
+    b0.add(wf.MapBuilder(double).build())
+    b1 = pipe.select(1)
+    merged = b0.merge(b1)
+    merged.add_sink(wf.SinkBuilder(sink).build())
+    g.run()
+    evens = sum(v for v in range(100) if v % 2 == 0)
+    odds = sum(v for v in range(100) if v % 2 == 1)
+    assert sink.total == 2 * evens + odds
+
+
+def test_split_of_unsplit_select_rejected():
+    g = wf.PipeGraph("bad", Mode.DEFAULT)
+    pipe = g.add_source(wf.SourceBuilder(source_fn(5)).build())
+    with pytest.raises(RuntimeError):
+        pipe.select(0)

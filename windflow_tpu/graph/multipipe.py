@@ -120,6 +120,9 @@ class MultiPipe:
             else:
                 in_ch = entry_channels[i]
             node = RtNode(f"{self.name}/{stage.name}.{i}", logic, in_ch, [])
+            if self.graph.config.tracing:
+                node.stats = self.graph.stats.register(
+                    f"{self.name}/{stage.name}", str(i))
             new_nodes.append(node)
             replica_nodes.append(node)
         if stage.collector is not None:
@@ -143,8 +146,11 @@ class MultiPipe:
             raise RuntimeError("source already present")
         self._mark_used(source)
         stage = source.stages()[0]
-        for logic in stage.replicas:
+        for i, logic in enumerate(stage.replicas):
             node = RtNode(f"{self.name}/{stage.name}", logic, None, [])
+            if self.graph.config.tracing:
+                node.stats = self.graph.stats.register(
+                    f"{self.name}/{stage.name}", str(i))
             self.nodes.append(node)
             self.tails.append(node)
         self.has_source = True

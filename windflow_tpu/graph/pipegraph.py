@@ -37,6 +37,8 @@ class PipeGraph:
         self.pipes: List[MultiPipe] = []
         self._dropped = 0
         self._dropped_lock = threading.Lock()
+        from ..monitoring.stats import GraphStats
+        self.stats = GraphStats(name)
         self._started = False
         self._ended = False
         self._monitor = None
@@ -176,9 +178,24 @@ class PipeGraph:
         self._ended = True
         if self._monitor is not None:
             self._monitor.stop()
+        if self.config.tracing:
+            self._dump_logs()
         if errors:
             name, err = errors[0]
             raise RuntimeError(f"node {name} failed: {err!r}") from err
+
+    def _dump_logs(self) -> None:
+        """Write per-graph stats JSON + graphviz DOT under log_dir
+        (pipegraph.hpp:683-709 dumps <pid>_<op>.json + a PDF diagram)."""
+        import os
+        from ..monitoring.monitor import graph_to_dot
+        d = self.config.log_dir
+        os.makedirs(d, exist_ok=True)
+        pid = os.getpid()
+        with open(os.path.join(d, f"{pid}_{self.name}.json"), "w") as f:
+            f.write(self.stats.to_json(self.get_num_dropped_tuples()))
+        with open(os.path.join(d, f"{pid}_{self.name}.dot"), "w") as f:
+            f.write(graph_to_dot(self))
 
     def run(self) -> None:
         self.start()

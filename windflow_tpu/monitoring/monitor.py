@@ -1,0 +1,112 @@
+"""MonitoringThread: the dashboard TCP reporter.
+
+Re-design of reference ``wf/monitoring.hpp`` (:162-314): connects to a
+dashboard at (machine, port) -- default localhost:20207 -- and speaks
+the same framed protocol:
+
+* type 0: registerApp    [int32 type][int32 len][payload: SVG diagram]
+          -> ack [int32 app_id]                        (:232-257)
+* type 1: sendReport     [int32 type][int32 app_id][int32 len][JSON]
+          every second                                 (:260-285)
+* type 2: deregisterApp  [int32 type][int32 app_id][int32 0]  (:288-313)
+
+Integers are little-endian int32 (the reference sends raw host-order
+ints from x86).  The graph diagram is emitted as graphviz DOT (the
+reference sends an SVG rendered via libgvc; DOT is the renderer-free
+equivalent carrying the same topology -- multipipe.hpp:522-591).
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+
+def graph_to_dot(graph) -> str:
+    """Graphviz description of the PipeGraph topology
+    (multipipe.hpp:522-591: vertices per operator, edges labelled by
+    routing mode)."""
+    lines = [f'digraph "{graph.name}" {{', "  rankdir=LR;"]
+    for pipe in graph.pipes:
+        prev = None
+        for name in pipe._op_names:
+            node_id = f"{pipe.name}_{name}".replace("/", "_").replace(
+                "(", "_").replace(")", "_").replace("+", "_")
+            lines.append(f'  {node_id} [label="{name}"];')
+            if prev is not None:
+                lines.append(f"  {prev} -> {node_id};")
+            prev = node_id
+    lines.append("}")
+    return "\n".join(lines)
+
+
+class MonitoringThread(threading.Thread):
+    """1 Hz stats reporter (monitoring.hpp:162-314)."""
+
+    def __init__(self, graph, machine: str = None, port: int = None,
+                 interval_s: float = 1.0):
+        super().__init__(name="windflow-monitor", daemon=True)
+        self.graph = graph
+        cfg = graph.config
+        self.machine = machine or cfg.dashboard_machine
+        self.port = port or cfg.dashboard_port
+        self.interval_s = interval_s
+        self._stop_evt = threading.Event()
+        self.app_id = -1
+        self.sock = None
+
+    # -- framed protocol ---------------------------------------------------
+    def _send_frame(self, *parts: bytes) -> None:
+        self.sock.sendall(b"".join(parts))
+
+    def _register(self) -> bool:
+        try:
+            self.sock = socket.create_connection(
+                (self.machine, self.port), timeout=2.0)
+            diagram = graph_to_dot(self.graph).encode()
+            self._send_frame(struct.pack("<ii", 0, len(diagram)), diagram)
+            ack = self.sock.recv(4)
+            if len(ack) == 4:
+                self.app_id = struct.unpack("<i", ack)[0]
+                return True
+        except OSError:
+            pass
+        return False
+
+    def _report(self) -> None:
+        payload = self._stats_json().encode()
+        self._send_frame(struct.pack("<iii", 1, self.app_id, len(payload)),
+                         payload)
+
+    def _deregister(self) -> None:
+        try:
+            self._send_frame(struct.pack("<iii", 2, self.app_id, 0))
+        except OSError:
+            pass
+
+    def _stats_json(self) -> str:
+        stats = getattr(self.graph, "stats", None)
+        if stats is not None:
+            return stats.to_json(self.graph.get_num_dropped_tuples())
+        return "{}"
+
+    # -- thread body -------------------------------------------------------
+    def run(self) -> None:
+        if not self._register():
+            return  # dashboard unreachable: tracing silently disabled
+        try:
+            while not self._stop_evt.is_set():
+                self._report()
+                self._stop_evt.wait(self.interval_s)
+            self._report()
+            self._deregister()
+        except OSError:
+            pass
+        finally:
+            if self.sock is not None:
+                self.sock.close()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        self.join(timeout=5.0)

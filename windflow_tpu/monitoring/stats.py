@@ -1,0 +1,114 @@
+"""Per-replica statistics records and JSON aggregation.
+
+Re-design of reference ``wf/stats_record.hpp`` (:45-165) and the
+JSON aggregation spread across operators (source.hpp:399-427) and
+PipeGraph (pipegraph.hpp:791-851).  Counters kept per replica, updated
+inline by the runtime node loop, aggregated into the same JSON shape
+the reference ships to its dashboard; device-era metrics replace the
+CUDA ones (kernels launched / bytes H2D/D2H -> program launches /
+bytes staged to device, stats_record.hpp:77-79).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class StatsRecord:
+    """Per-replica counters (stats_record.hpp:45-165)."""
+
+    operator_name: str = ""
+    replica_id: str = "0"
+    start_time: float = field(default_factory=time.time)
+    terminated: bool = False
+    inputs_received: int = 0
+    bytes_received: int = 0
+    outputs_sent: int = 0
+    bytes_sent: int = 0
+    inputs_ignored: int = 0
+    # EWMA service times (microseconds), updated inline like
+    # win_seq.hpp:499-509
+    service_time_us: float = 0.0
+    eff_service_time_us: float = 0.0
+    # device metrics (TPU analogues of stats_record.hpp:77-79)
+    num_launches: int = 0
+    bytes_to_device: int = 0
+    bytes_from_device: int = 0
+
+    def observe(self, elapsed_us: float) -> None:
+        n = max(1, self.inputs_received)
+        self.service_time_us += (elapsed_us - self.service_time_us) / n
+
+    def set_terminated(self) -> None:
+        self.terminated = True
+
+    def to_dict(self) -> dict:
+        return {
+            "Replica_id": self.replica_id,
+            "Starting_time": self.start_time,
+            "Terminated": self.terminated,
+            "Inputs_received": self.inputs_received,
+            "Bytes_received": self.bytes_received,
+            "Outputs_sent": self.outputs_sent,
+            "Bytes_sent": self.bytes_sent,
+            "Inputs_ignored": self.inputs_ignored,
+            "Service_time_usec": round(self.service_time_us, 3),
+            "Eff_Service_time_usec": round(self.eff_service_time_us, 3),
+            "Device_launches": self.num_launches,
+            "Bytes_to_device": self.bytes_to_device,
+            "Bytes_from_device": self.bytes_from_device,
+        }
+
+
+def get_mem_usage_kb() -> int:
+    """Process RSS in KiB (monitoring.hpp:49-68 reads /proc/self/status)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    return 0
+
+
+class GraphStats:
+    """Aggregates per-operator replica records into the dashboard JSON
+    (pipegraph.hpp:791-851 generate_JSONStats)."""
+
+    def __init__(self, graph_name: str):
+        self.graph_name = graph_name
+        self.lock = threading.Lock()
+        self.records: Dict[str, List[StatsRecord]] = {}
+
+    def register(self, operator_name: str, replica_id: str) -> StatsRecord:
+        rec = StatsRecord(operator_name, replica_id)
+        with self.lock:
+            self.records.setdefault(operator_name, []).append(rec)
+        return rec
+
+    def to_json(self, dropped_tuples: int = 0) -> str:
+        with self.lock:
+            ops = [
+                {
+                    "Operator_name": name,
+                    "Operator_type": name.rsplit("/", 1)[-1],
+                    "Parallelism": len(replicas),
+                    "Replicas": [r.to_dict() for r in replicas],
+                }
+                for name, replicas in self.records.items()
+            ]
+        return json.dumps({
+            "PipeGraph_name": self.graph_name,
+            "Mode": "DEFAULT",
+            "Backpressure": "ON",
+            "Dropped_tuples": dropped_tuples,
+            "Memory_usage_KB": get_mem_usage_kb(),
+            "Operator_number": len(ops),
+            "Operators": ops,
+        })

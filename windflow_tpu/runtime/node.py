@@ -85,8 +85,11 @@ class RtNode(threading.Thread):
         self.channel = channel
         self.outlets = list(outlets)
         self.error: Optional[BaseException] = None
+        self.stats = None  # StatsRecord when tracing is enabled
 
     def _emit(self, item: Any) -> None:
+        if self.stats is not None:
+            self.stats.outputs_sent += 1
         for o in self.outlets:
             o.send(item)
 
@@ -94,13 +97,23 @@ class RtNode(threading.Thread):
         try:
             self.logic.svc_init()
             if self.channel is not None:
+                stats = self.stats
                 while True:
                     got = self.channel.get()
                     if got is None:
                         break
                     cid, item = got
-                    self.logic.svc(item, cid, self._emit)
+                    if stats is not None:
+                        import time as _time
+                        stats.inputs_received += 1
+                        t0 = _time.perf_counter()
+                        self.logic.svc(item, cid, self._emit)
+                        stats.observe((_time.perf_counter() - t0) * 1e6)
+                    else:
+                        self.logic.svc(item, cid, self._emit)
             self.logic.eos_flush(self._emit)
+            if self.stats is not None:
+                self.stats.set_terminated()
         except BaseException as e:  # surfaced by PipeGraph.wait_end
             self.error = e
             traceback.print_exc()
