@@ -1,0 +1,158 @@
+// Native host-runtime core for windflow_tpu.
+//
+// Plays the role FastFlow plays for the reference (SURVEY.md L0):
+// bounded channels with per-producer EOS accounting carrying opaque
+// item handles (PyObject* from the Python plane, any pointer from a
+// future all-native plane), plus the vectorizable host-plane kernels of
+// the columnar dataplane (key partitioning, pane partial reduction).
+//
+// Exposed as a plain C ABI consumed via ctypes
+// (windflow_tpu/runtime/native.py) -- no pybind11 dependency.
+//
+// Threading contract: all blocking waits happen outside the Python GIL
+// (ctypes releases it around foreign calls), so a Python producer
+// blocked on a full channel never stalls consumers.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Item {
+    int producer;
+    std::uintptr_t handle;
+    bool eos;
+};
+
+// Bounded MPSC channel with per-producer EOS accounting
+// (the FF_BOUNDED_BUFFER-backpressure analogue).
+struct Channel {
+    explicit Channel(std::size_t cap) : capacity(cap) {}
+
+    std::size_t capacity;
+    std::mutex mu;
+    std::condition_variable not_full;
+    std::condition_variable not_empty;
+    std::deque<Item> q;
+    int n_producers = 0;
+    int eos_seen = 0;
+
+    int register_producer() {
+        std::lock_guard<std::mutex> lk(mu);
+        return n_producers++;
+    }
+
+    void put(int producer, std::uintptr_t handle, bool eos) {
+        std::unique_lock<std::mutex> lk(mu);
+        not_full.wait(lk, [&] { return q.size() < capacity || eos; });
+        q.push_back(Item{producer, handle, eos});
+        not_empty.notify_one();
+    }
+
+    // Returns 1 with *handle/*cid set; 0 once every producer closed.
+    int get(std::uintptr_t* handle, int* cid) {
+        std::unique_lock<std::mutex> lk(mu);
+        for (;;) {
+            not_empty.wait(lk, [&] { return !q.empty(); });
+            Item it = q.front();
+            q.pop_front();
+            not_full.notify_one();
+            if (it.eos) {
+                if (++eos_seen >= n_producers) return 0;
+                continue;
+            }
+            *handle = it.handle;
+            *cid = it.producer;
+            return 1;
+        }
+    }
+
+    std::size_t size() {
+        std::lock_guard<std::mutex> lk(mu);
+        return q.size();
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* wfn_channel_new(std::size_t capacity) {
+    return new Channel(capacity == 0 ? 1 : capacity);
+}
+
+void wfn_channel_free(void* ch) { delete static_cast<Channel*>(ch); }
+
+int wfn_channel_register_producer(void* ch) {
+    return static_cast<Channel*>(ch)->register_producer();
+}
+
+void wfn_channel_put(void* ch, int producer, std::uintptr_t handle) {
+    static_cast<Channel*>(ch)->put(producer, handle, false);
+}
+
+void wfn_channel_close(void* ch, int producer) {
+    static_cast<Channel*>(ch)->put(producer, 0, true);
+}
+
+int wfn_channel_get(void* ch, std::uintptr_t* handle, int* cid) {
+    return static_cast<Channel*>(ch)->get(handle, cid);
+}
+
+std::size_t wfn_channel_size(void* ch) {
+    return static_cast<Channel*>(ch)->size();
+}
+
+// --- columnar host kernels -------------------------------------------------
+
+// Pane partial sums: out[i] = sum(values[pos[i] .. pos[i+1]))
+// (the host PLQ pre-reduction of the transport optimization).
+void wfn_pane_sum(const double* values, const long long* pos,
+                  long long n_panes, double* out) {
+    for (long long i = 0; i < n_panes; ++i) {
+        double acc = 0.0;
+        for (long long j = pos[i]; j < pos[i + 1]; ++j) acc += values[j];
+        out[i] = acc;
+    }
+}
+
+void wfn_pane_max(const double* values, const long long* pos,
+                  long long n_panes, double neutral, double* out) {
+    for (long long i = 0; i < n_panes; ++i) {
+        double acc = neutral;
+        for (long long j = pos[i]; j < pos[i + 1]; ++j)
+            if (values[j] > acc) acc = values[j];
+        out[i] = acc;
+    }
+}
+
+void wfn_pane_min(const double* values, const long long* pos,
+                  long long n_panes, double neutral, double* out) {
+    for (long long i = 0; i < n_panes; ++i) {
+        double acc = neutral;
+        for (long long j = pos[i]; j < pos[i + 1]; ++j)
+            if (values[j] < acc) acc = values[j];
+        out[i] = acc;
+    }
+}
+
+// KEYBY partitioning of a columnar batch: dest[i] = |keys[i]| % ndest,
+// and per-destination counts (the vectorized Standard/KF emitter).
+void wfn_partition_mod(const long long* keys, long long n, long long ndest,
+                       int* dest, long long* counts) {
+    std::memset(counts, 0, sizeof(long long) * ndest);
+    for (long long i = 0; i < n; ++i) {
+        long long k = keys[i];
+        if (k < 0) k = -k;
+        int d = static_cast<int>(k % ndest);
+        dest[i] = d;
+        ++counts[d];
+    }
+}
+
+}  // extern "C"

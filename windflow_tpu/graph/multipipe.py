@@ -17,7 +17,7 @@ from ..operators.base import Operator, StageSpec
 from ..runtime.emitters import SplittingEmitter, StandardEmitter
 from ..runtime.node import NodeLogic, Outlet, RtNode
 from ..runtime.ordering import KSlackLogic, OrderingLogic
-from ..runtime.queues import Channel
+from ..runtime.queues import Channel, make_channel
 
 
 class ChainedLogic(NodeLogic):
@@ -90,12 +90,12 @@ class MultiPipe:
     def _append_stage(self, stage: StageSpec,
                       win_type: Optional[WinType] = None):
         n = len(stage.replicas)
-        cap = self.graph.config.queue_capacity
+        cfg = self.graph.config
         # per-replica inbound channel (collector front-end when required)
         collector_logics = [
             self._collector_for(stage.ordering_mode, len(self.tails), win_type)
             for _ in range(n)]
-        entry_channels: List[Channel] = [Channel(cap) for _ in range(n)]
+        entry_channels: List[Channel] = [make_channel(cfg) for _ in range(n)]
         # emitter clone per upstream producer (reference: emitter combined
         # into each tail node, multipipe.hpp:302-338)
         for tail in self.tails:
@@ -107,7 +107,7 @@ class MultiPipe:
         replica_nodes: List[RtNode] = []
         for i, logic in enumerate(stage.replicas):
             if collector_logics[i] is not None:
-                rep_ch = Channel(cap)
+                rep_ch = make_channel(cfg)
                 coll_node = RtNode(
                     f"{self.name}/{stage.name}.coll{i}", collector_logics[i],
                     entry_channels[i], [])
@@ -126,7 +126,7 @@ class MultiPipe:
             new_nodes.append(node)
             replica_nodes.append(node)
         if stage.collector is not None:
-            cch = Channel(cap)
+            cch = make_channel(cfg)
             cnode = RtNode(f"{self.name}/{stage.name}.collector",
                            stage.collector, cch, [])
             for rn in replica_nodes:

@@ -94,17 +94,16 @@ class PipeGraph:
         # wire: each tail gets a SplittingEmitter whose branch b leads to
         # the (future) first operator of child b.  We defer binding by
         # giving each child a relay channel the parent writes into.
-        from ..runtime.queues import Channel
+        from ..runtime.queues import make_channel
         from ..runtime.node import NodeLogic, Outlet
 
         class _Relay(NodeLogic):
             def svc(self, item, channel_id, emit):
                 emit(item)
 
-        cap = self.config.queue_capacity
         relay_nodes = []
         for child in children:
-            ch = Channel(cap)
+            ch = make_channel(self.config)
             relay = RtNode(f"{child.name}/relay", _Relay(), ch, [])
             child.nodes.append(relay)
             child.tails = [relay]

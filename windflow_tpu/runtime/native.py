@@ -1,0 +1,161 @@
+"""ctypes bindings to the native C++ host runtime (native/windflow_native.cpp).
+
+Builds the shared library on first use with g++ (no pip/pybind11
+dependency), caches it next to the sources, and degrades gracefully to
+the pure-Python plane when a toolchain is unavailable
+(RuntimeConfig.use_native_runtime gates usage).
+
+Object hand-off across the native channel: the producer increfs the
+Python object and passes its address; the consumer rebuilds the object
+reference and decrefs.  Blocking waits happen in C++ with the GIL
+released (ctypes drops it around foreign calls).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Any, Optional, Tuple
+
+_lib = None
+_lib_lock = threading.Lock()
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native",
+    "windflow_native.cpp")
+_SO = os.path.join(os.path.dirname(_SRC), "libwindflow_native.so")
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+             _SRC, "-o", _SO],
+            check=True, capture_output=True, timeout=120)
+        return _SO
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def get_lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib if _lib is not False else None
+        so = _build()
+        if so is None:
+            _lib = False
+            return None
+        lib = ctypes.CDLL(so)
+        lib.wfn_channel_new.restype = ctypes.c_void_p
+        lib.wfn_channel_new.argtypes = [ctypes.c_size_t]
+        lib.wfn_channel_free.argtypes = [ctypes.c_void_p]
+        lib.wfn_channel_register_producer.restype = ctypes.c_int
+        lib.wfn_channel_register_producer.argtypes = [ctypes.c_void_p]
+        lib.wfn_channel_put.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.c_size_t]
+        lib.wfn_channel_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.wfn_channel_get.restype = ctypes.c_int
+        lib.wfn_channel_get.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_int)]
+        lib.wfn_channel_size.restype = ctypes.c_size_t
+        lib.wfn_channel_size.argtypes = [ctypes.c_void_p]
+        lib.wfn_pane_sum.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_longlong),
+            ctypes.c_longlong, ctypes.POINTER(ctypes.c_double)]
+        for name in ("wfn_pane_max", "wfn_pane_min"):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
+                ctypes.c_double, ctypes.POINTER(ctypes.c_double)]
+        lib.wfn_partition_mod.argtypes = [
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_longlong)]
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+class NativeChannel:
+    """Drop-in for runtime.queues.Channel backed by the C++ channel."""
+
+    __slots__ = ("lib", "ptr", "n_producers")
+
+    def __init__(self, capacity: int = 2048):
+        self.lib = get_lib()
+        if self.lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self.ptr = self.lib.wfn_channel_new(capacity)
+        self.n_producers = 0
+
+    def register_producer(self) -> int:
+        self.n_producers += 1
+        return self.lib.wfn_channel_register_producer(self.ptr)
+
+    def put(self, producer_id: int, item: Any) -> None:
+        ctypes.pythonapi.Py_IncRef(ctypes.py_object(item))
+        self.lib.wfn_channel_put(self.ptr, producer_id, id(item))
+
+    def close(self, producer_id: int) -> None:
+        self.lib.wfn_channel_close(self.ptr, producer_id)
+
+    def get(self) -> Optional[Tuple[int, Any]]:
+        handle = ctypes.c_size_t()
+        cid = ctypes.c_int()
+        ok = self.lib.wfn_channel_get(self.ptr, ctypes.byref(handle),
+                                      ctypes.byref(cid))
+        if not ok:
+            return None
+        obj = ctypes.cast(handle.value, ctypes.py_object).value
+        ctypes.pythonapi.Py_DecRef(ctypes.py_object(obj))
+        return cid.value, obj
+
+    def qsize(self) -> int:
+        return self.lib.wfn_channel_size(self.ptr)
+
+    def __del__(self):
+        lib, ptr = getattr(self, "lib", None), getattr(self, "ptr", None)
+        if lib is not None and ptr:
+            # drain remaining handles to avoid leaking references
+            handle = ctypes.c_size_t()
+            cid = ctypes.c_int()
+            while lib.wfn_channel_size(self.ptr):
+                if not lib.wfn_channel_get(self.ptr, ctypes.byref(handle),
+                                           ctypes.byref(cid)):
+                    break
+                obj = ctypes.cast(handle.value, ctypes.py_object).value
+                ctypes.pythonapi.Py_DecRef(ctypes.py_object(obj))
+            lib.wfn_channel_free(ptr)
+
+
+def pane_reduce(values, pos, kind: str):
+    """Native pane partial reduction; returns None if lib unavailable."""
+    import numpy as np
+    lib = get_lib()
+    if lib is None:
+        return None
+    values = np.ascontiguousarray(values, np.float64)
+    pos = np.ascontiguousarray(pos, np.int64)
+    n = len(pos) - 1
+    out = np.empty(n, np.float64)
+    vp = values.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+    pp = pos.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong))
+    op = out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+    if kind == "sum":
+        lib.wfn_pane_sum(vp, pp, n, op)
+    elif kind == "max":
+        lib.wfn_pane_max(vp, pp, n, float("-inf"), op)
+    elif kind == "min":
+        lib.wfn_pane_min(vp, pp, n, float("inf"), op)
+    else:
+        return None
+    return out

@@ -60,3 +60,17 @@ class Channel:
 
     def qsize(self) -> int:
         return self.q.qsize()
+
+
+def make_channel(config=None) -> "Channel":
+    """Channel factory: prefers the native C++ channel when the runtime
+    config allows it and the toolchain built it (runtime/native.py)."""
+    cap = config.queue_capacity if config is not None else DEFAULT_QUEUE_CAPACITY
+    if config is None or config.use_native_runtime:
+        try:
+            from .native import NativeChannel, native_available
+            if native_available():
+                return NativeChannel(cap)
+        except Exception:
+            pass
+    return Channel(cap)
