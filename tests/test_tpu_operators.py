@@ -240,3 +240,21 @@ def test_win_seqffat_tpu_builtin():
     coll = run_graph(b.build())
     expect = oracle(48, 10, 5, agg=max)
     assert coll.by_key() == {k: expect for k in range(3)}
+
+
+class TestPallasKernels:
+    def test_window_sums_matches_numpy(self):
+        from windflow_tpu.ops.pallas.window_sum import window_sums
+        rng = np.random.default_rng(1)
+        vals = rng.normal(size=5000).astype(np.float32)
+        starts = np.sort(rng.integers(0, 4000, 20)).astype(np.int32)
+        ends = (starts + rng.integers(1, 900, 20)).astype(np.int32)
+        out = window_sums(vals, starts, ends)
+        expect = [vals[s:e].sum() for s, e in zip(starts, ends)]
+        np.testing.assert_allclose(out, expect, rtol=1e-3)
+
+    def test_window_sums_empty_and_single(self):
+        from windflow_tpu.ops.pallas.window_sum import window_sums
+        vals = np.arange(300, dtype=np.float32)
+        out = window_sums(vals, np.array([5, 10, 0]), np.array([5, 11, 300]))
+        np.testing.assert_allclose(out, [0.0, 10.0, vals.sum()], rtol=1e-4)

@@ -1,0 +1,91 @@
+"""Pallas TPU kernel: batched window reduction over a flat buffer.
+
+The direct TPU analogue of the reference's grid-stride
+``ComputeBatch_Kernel`` (win_seq_gpu.hpp:61-84): one grid program per
+fired window instead of one CUDA thread per window.  Window extents
+arrive via scalar prefetch (SMEM) so each program DMAs only the tiles
+its window touches; lanes outside the extent are masked.
+
+This is the hand-scheduled alternative to the XLA cumsum path in
+ops/window_compute.py -- profitable when windows are short relative to
+the buffer (e.g. after pane pre-reduction) because it avoids
+materializing the prefix scan, and when results feed further device
+work without a host round trip.  `window_sums` picks interpret mode off
+TPU so tests exercise the same kernel on CPU.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+LANES = 128
+
+
+@functools.lru_cache(maxsize=None)
+def _build(n_rows: int, n_windows: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(starts_ref, ends_ref, values_ref, out_ref):
+        b = pl.program_id(0)
+        start = starts_ref[b]
+        end = ends_ref[b]
+        first_row = start // LANES
+        last_row = jax.lax.max(end - 1, 0) // LANES
+
+        def body(row, acc):
+            vals = values_ref[row, :]
+            lane = row * LANES + jax.lax.broadcasted_iota(
+                jnp.int32, (LANES,), 0)
+            mask = (lane >= start) & (lane < end)
+            return acc + jnp.sum(jnp.where(mask, vals, 0.0))
+
+        total = jax.lax.fori_loop(first_row, last_row + 1, body, 0.0)
+        total = jnp.where(end > start, total, 0.0)
+        # one lane-row per window (1x1 output blocks are not lowerable;
+        # the host reads column 0)
+        out_ref[b, :] = jnp.full((LANES,), total, jnp.float32)
+
+    n_out_rows = ((n_windows + 7) // 8) * 8  # tile-aligned row count
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_windows,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),  # unblocked
+    )
+
+    @jax.jit
+    def run(starts, ends, values2d):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n_out_rows, LANES), jnp.float32),
+            grid_spec=grid_spec,
+            interpret=interpret,
+        )(starts, ends, values2d)
+
+    return run
+
+
+def window_sums(values: np.ndarray, starts: np.ndarray,
+                ends: np.ndarray, interpret: bool = None):
+    """out[b] = sum(values[starts[b]:ends[b]]) via the Pallas kernel.
+
+    values is padded to a multiple of 128 lanes; starts/ends are int32.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu",)
+    T = len(values)
+    n_rows = max(1, (T + LANES - 1) // LANES)
+    padded = np.zeros(n_rows * LANES, np.float32)
+    padded[:T] = values
+    B = len(starts)
+    run = _build(n_rows, B, bool(interpret))
+    out = run(jnp.asarray(starts, jnp.int32), jnp.asarray(ends, jnp.int32),
+              jnp.asarray(padded.reshape(n_rows, LANES)))
+    return np.asarray(out)[:B, 0]
