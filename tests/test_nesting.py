@@ -1,0 +1,145 @@
+"""Complex-nesting tests: WF(PF), WF(WMR), KF(PF), KF(WMR).
+
+Mirrors tests/mp_tests_cpu test_mp_{wf+pf, wf+wmr, kf+pf, kf+wmr}_*
+(SURVEY.md §4): nested composite operators against the sequential
+oracle, across window types and replica counts.
+"""
+import threading
+
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core import BasicRecord, Mode, WinType
+
+
+def ordered_source(n_keys, per_key):
+    state = {}
+
+    def fn(shipper, ctx):
+        i = state.setdefault("i", 0)
+        if i >= n_keys * per_key:
+            return False
+        key = i % n_keys
+        tid = i // n_keys
+        shipper.push(BasicRecord(key, tid, tid, float(tid)))
+        state["i"] = i + 1
+        return True
+
+    return fn
+
+
+class Collector:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.results = []
+
+    def __call__(self, rec):
+        if rec is not None:
+            with self.lock:
+                self.results.append((rec.key, rec.id, rec.value))
+
+    def by_key(self):
+        out = {}
+        for k, g, v in self.results:
+            out.setdefault(k, {})[g] = v
+        return out
+
+
+def sum_win(gwid, it, result):
+    result.value = sum(t.value for t in it)
+
+
+def oracle(per_key, win, slide):
+    out = {}
+    g = 0
+    while g * slide < per_key:
+        out[g] = float(sum(v for v in range(per_key)
+                           if g * slide <= v < g * slide + win))
+        g += 1
+    return out
+
+
+def run_graph(op, n_keys=3, per_key=48, mode=Mode.DEFAULT):
+    coll = Collector()
+    g = wf.PipeGraph("t", mode)
+    g.add_source(wf.SourceBuilder(ordered_source(n_keys, per_key)).build()) \
+        .add(op).add_sink(wf.SinkBuilder(coll).build())
+    g.run()
+    return coll
+
+
+WIN, SLIDE = 12, 4
+
+
+def make_pf(pars=(2, 1), win_type=WinType.TB):
+    b = wf.PaneFarmBuilder(sum_win, sum_win).with_parallelism(*pars)
+    b = (b.with_cb_windows(WIN, SLIDE) if win_type == WinType.CB
+         else b.with_tb_windows(WIN, SLIDE))
+    return b.build()
+
+
+def make_wmr(pars=(2, 1), win_type=WinType.TB):
+    b = wf.WinMapReduceBuilder(sum_win, sum_win).with_parallelism(*pars)
+    b = (b.with_cb_windows(WIN, SLIDE) if win_type == WinType.CB
+         else b.with_tb_windows(WIN, SLIDE))
+    return b.build()
+
+
+@pytest.mark.parametrize("replicas", [1, 2, 3])
+def test_wf_pf_tb(replicas):
+    op = wf.WinFarmBuilder(make_pf()).with_parallelism(replicas).build()
+    coll = run_graph(op)
+    expect = oracle(48, WIN, SLIDE)
+    assert coll.by_key() == {k: expect for k in range(3)}
+
+
+@pytest.mark.parametrize("replicas", [2, 3])
+def test_wf_wmr_tb(replicas):
+    op = wf.WinFarmBuilder(make_wmr()).with_parallelism(replicas).build()
+    coll = run_graph(op)
+    expect = oracle(48, WIN, SLIDE)
+    assert coll.by_key() == {k: expect for k in range(3)}
+
+
+@pytest.mark.parametrize("replicas", [1, 2, 3])
+@pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
+def test_kf_pf(replicas, win_type):
+    op = wf.KeyFarmBuilder(make_pf(win_type=win_type)) \
+        .with_parallelism(replicas).build()
+    coll = run_graph(op, n_keys=5)
+    expect = oracle(48, WIN, SLIDE)
+    assert coll.by_key() == {k: expect for k in range(5)}
+
+
+@pytest.mark.parametrize("replicas", [2, 3])
+@pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
+def test_kf_wmr(replicas, win_type):
+    op = wf.KeyFarmBuilder(make_wmr(pars=(3, 1), win_type=win_type)) \
+        .with_parallelism(replicas).build()
+    coll = run_graph(op, n_keys=5)
+    expect = oracle(48, WIN, SLIDE)
+    assert coll.by_key() == {k: expect for k in range(5)}
+
+
+def test_wf_pf_cb_default_rejected():
+    op = wf.WinFarmBuilder(make_pf(win_type=WinType.CB)) \
+        .with_parallelism(2).build()
+    g = wf.PipeGraph("t", Mode.DEFAULT)
+    pipe = g.add_source(wf.SourceBuilder(ordered_source(1, 8)).build())
+    with pytest.raises(RuntimeError, match="DEFAULT"):
+        pipe.add(op)
+
+
+def test_wf_pf_cb_deterministic():
+    op = wf.WinFarmBuilder(make_pf(win_type=WinType.CB)) \
+        .with_parallelism(2).build()
+    coll = run_graph(op, mode=Mode.DETERMINISTIC)
+    expect = oracle(48, WIN, SLIDE)
+    assert coll.by_key() == {k: expect for k in range(3)}
+
+
+def test_inner_reuse_rejected():
+    pf = make_pf()
+    wf.WinFarmBuilder(pf).with_parallelism(2).build()
+    with pytest.raises(RuntimeError, match="nested"):
+        wf.WinFarmBuilder(pf).with_parallelism(2).build()

@@ -223,7 +223,15 @@ class WinFarmBuilder(_WinBuilderBase):
         self.ordered = ordered
         return self
 
-    def build(self) -> WinFarm:
+    def build(self):
+        from ..operators.nesting import NestedWinFarm
+        from ..operators.pane_farm import PaneFarm
+        from ..operators.win_mapreduce import WinMapReduce
+        if isinstance(self.fn, (PaneFarm, WinMapReduce)):
+            # nesting constructor (win_farm.hpp:259-378): replicate the
+            # inner complex operator; windowing comes from the inner op
+            return NestedWinFarm(self.fn, self.parallelism, self.name,
+                                 self.ordered, self.opt_level)
         self._check_windows()
         return WinFarm(self.fn, self.win_len, self.slide_len, self.win_type,
                        self.parallelism, self.triggering_delay,
@@ -237,7 +245,14 @@ class KeyFarmBuilder(_WinBuilderBase):
 
     _default_name = "key_farm"
 
-    def build(self) -> KeyFarm:
+    def build(self):
+        from ..operators.nesting import NestedKeyFarm
+        from ..operators.pane_farm import PaneFarm
+        from ..operators.win_mapreduce import WinMapReduce
+        if isinstance(self.fn, (PaneFarm, WinMapReduce)):
+            # nesting constructor (key_farm.hpp:254-...)
+            return NestedKeyFarm(self.fn, self.parallelism, self.name,
+                                 self.opt_level)
         self._check_windows()
         return KeyFarm(self.fn, self.win_len, self.slide_len, self.win_type,
                        self.parallelism, self.triggering_delay,

@@ -91,18 +91,50 @@ class MultiPipe:
                       win_type: Optional[WinType] = None):
         n = len(stage.replicas)
         cfg = self.graph.config
+        grouped = (stage.group_emitters is not None
+                   and all(getattr(t, "group", None) is not None
+                           for t in self.tails) and len(self.tails) > 0)
+        if grouped:
+            n_producers = max(1, len([t for t in self.tails
+                                      if t.group == self.tails[0].group]))
+        else:
+            n_producers = len(self.tails)
         # per-replica inbound channel (collector front-end when required)
         collector_logics = [
-            self._collector_for(stage.ordering_mode, len(self.tails), win_type)
+            self._collector_for(stage.ordering_mode, n_producers, win_type)
             for _ in range(n)]
         entry_channels: List[Channel] = [make_channel(cfg) for _ in range(n)]
         # emitter clone per upstream producer (reference: emitter combined
         # into each tail node, multipipe.hpp:302-338)
-        for tail in self.tails:
-            em = stage.emitter_proto.clone()
-            em.set_n_destinations(n)
-            dests = [(ch, ch.register_producer()) for ch in entry_channels]
-            tail.outlets.append(Outlet(em, dests))
+        if grouped:
+            # complex nesting: tails of group g feed only the replicas of
+            # group g, through that group's emitter prototype
+            group_members = {}
+            for i, g in enumerate(stage.groups):
+                group_members.setdefault(g, []).append(i)
+            for tail in self.tails:
+                members = group_members[tail.group]
+                em = stage.group_emitters[tail.group].clone()
+                em.set_n_destinations(len(members))
+                dests = [(entry_channels[i],
+                          entry_channels[i].register_producer())
+                         for i in members]
+                tail.outlets.append(Outlet(em, dests))
+        else:
+            for tail in self.tails:
+                em = stage.emitter_proto.clone()
+                em.set_n_destinations(n)
+                from ..runtime.emitters import TreeEmitter
+                if isinstance(em, TreeEmitter) and stage.groups is not None:
+                    sizes: List[int] = []
+                    for g in stage.groups:
+                        while g >= len(sizes):
+                            sizes.append(0)
+                        sizes[g] += 1
+                    em.set_child_widths(sizes)
+                dests = [(ch, ch.register_producer())
+                         for ch in entry_channels]
+                tail.outlets.append(Outlet(em, dests))
         new_nodes: List[RtNode] = []
         replica_nodes: List[RtNode] = []
         for i, logic in enumerate(stage.replicas):
@@ -120,12 +152,36 @@ class MultiPipe:
             else:
                 in_ch = entry_channels[i]
             node = RtNode(f"{self.name}/{stage.name}.{i}", logic, in_ch, [])
+            node.group = stage.groups[i] if stage.groups is not None else None
             if self.graph.config.tracing:
                 node.stats = self.graph.stats.register(
                     f"{self.name}/{stage.name}", str(i))
             new_nodes.append(node)
             replica_nodes.append(node)
-        if stage.collector is not None:
+        if stage.group_collectors is not None:
+            # complex nesting: one collector per inner-copy group (e.g.
+            # each replicated PLQ's ordered collector); the next grouped
+            # stage consumes from its group's collector
+            coll_nodes = []
+            for g, coll in enumerate(stage.group_collectors):
+                members = [rn for rn, gg in zip(replica_nodes, stage.groups)
+                           if gg == g]
+                if coll is None:
+                    coll_nodes.extend(members)
+                    continue
+                cch = make_channel(cfg)
+                cnode = RtNode(f"{self.name}/{stage.name}.coll.g{g}", coll,
+                               cch, [])
+                cnode.group = g
+                for rn in members:
+                    fwd = StandardEmitter()
+                    fwd.set_n_destinations(1)
+                    rn.outlets.append(
+                        Outlet(fwd, [(cch, cch.register_producer())]))
+                new_nodes.append(cnode)
+                coll_nodes.append(cnode)
+            self.tails = coll_nodes
+        elif stage.collector is not None:
             cch = make_channel(cfg)
             cnode = RtNode(f"{self.name}/{stage.name}.collector",
                            stage.collector, cch, [])

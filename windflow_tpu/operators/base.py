@@ -33,6 +33,16 @@ class StageSpec:
     ordering_mode: Optional[OrderingMode] = None
     # farm-level collector merging replica outputs (e.g. ordered WF)
     collector: Optional[NodeLogic] = None
+    # complex nesting (WF/KF over PF/WMR, multipipe.hpp:1014-1099):
+    # group id per replica; a grouped stage receives only from upstream
+    # tails of the same group (the per-worker sub-pipelines of the
+    # reference's replicated inner operators)
+    groups: Optional[List[int]] = None
+    # per-group inbound emitter prototypes (used instead of
+    # emitter_proto when the PREVIOUS stage was grouped)
+    group_emitters: Optional[List[Emitter]] = None
+    # per-group farm collectors (e.g. each inner PLQ's ordered collector)
+    group_collectors: Optional[List[NodeLogic]] = None
 
 
 class Operator:

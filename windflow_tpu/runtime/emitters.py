@@ -142,6 +142,9 @@ class TreeEmitter(Emitter):
                 send_to(off + d, it)
             return send_child
 
-        self.root.eos(lambda ci, it: None)
+        # root trailing items (e.g. WF per-key EOS markers) route through
+        # the child emitters exactly like regular traffic
+        self.root.eos(lambda ci, it: self.children[ci].emit(
+            it, to_child(ci)))
         for ci, c in enumerate(self.children):
             c.eos(to_child(ci))

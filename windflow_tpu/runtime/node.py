@@ -86,6 +86,7 @@ class RtNode(threading.Thread):
         self.outlets = list(outlets)
         self.error: Optional[BaseException] = None
         self.stats = None  # StatsRecord when tracing is enabled
+        self.group = None  # complex-nesting group id (multipipe grouping)
 
     def _emit(self, item: Any) -> None:
         if self.stats is not None:
