@@ -1,0 +1,269 @@
+"""The mp_tests matrix: full pipelines in the reference test style.
+
+Replicates the structure of tests/mp_tests_cpu + mp_tests_gpu
+(SURVEY.md §4): a pipeline prefix source -> filter -> flatmap -> map
+before the window operator, every window operator x CB/TB x
+DEFAULT/DETERMINISTIC/PROBABILISTIC (the _oop/_prob variants) x string
+keys (_string variants), with randomized parallelisms and the
+global-aggregate determinism oracle.
+"""
+import random
+import threading
+
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core import BasicRecord, Mode, WinType
+from windflow_tpu.utils.synthetic import (ordered_keyed_stream,
+                                          pareto_ooo_stream)
+
+N_KEYS, PER_KEY = 4, 60
+WIN, SLIDE = 10, 5
+
+
+class SumSink:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.total = 0.0
+        self.count = 0
+
+    def __call__(self, rec):
+        if rec is not None:
+            with self.lock:
+                self.total += rec.value
+                self.count += 1
+
+
+def sum_win(gwid, it, result):
+    result.value = sum(t.value for t in it)
+
+
+def prefix_ops(rnd):
+    """source -> filter(pass-all) -> flatmap(x1) -> map(identity) with
+    randomized parallelisms (test_mp_* pipeline prefix)."""
+
+    def keep(t):
+        return True
+
+    def fm(t, shipper):
+        shipper.push(t)
+
+    def ident(t):
+        pass
+
+    return (wf.FilterBuilder(keep).with_parallelism(rnd.randint(1, 3)).build(),
+            wf.FlatMapBuilder(fm).with_parallelism(rnd.randint(1, 3)).build(),
+            wf.MapBuilder(ident).with_parallelism(rnd.randint(1, 3)).build())
+
+
+def build_window_op(kind, win_type, par, rnd):
+    if kind == "wf":
+        b = wf.WinFarmBuilder(sum_win).with_parallelism(par)
+    elif kind == "kf":
+        b = wf.KeyFarmBuilder(sum_win).with_parallelism(par)
+    elif kind == "kff":
+        b = wf.KeyFFATBuilder(lambda t, r: setattr(r, "value", t.value),
+                              lambda a, c, o: setattr(o, "value",
+                                                      a.value + c.value)) \
+            .with_parallelism(par)
+    elif kind == "pf":
+        b = wf.PaneFarmBuilder(sum_win, sum_win) \
+            .with_parallelism(par, max(1, par - 1))
+    elif kind == "wmr":
+        b = wf.WinMapReduceBuilder(sum_win, sum_win) \
+            .with_parallelism(max(2, par), 1)
+    elif kind == "kf+pf":
+        inner = wf.PaneFarmBuilder(sum_win, sum_win).with_parallelism(2, 1) \
+            .with_tb_windows(WIN, SLIDE).build() if win_type == WinType.TB \
+            else wf.PaneFarmBuilder(sum_win, sum_win).with_parallelism(2, 1) \
+            .with_cb_windows(WIN, SLIDE).build()
+        return wf.KeyFarmBuilder(inner).with_parallelism(par).build()
+    elif kind == "wf+wmr":
+        inner = wf.WinMapReduceBuilder(sum_win, sum_win) \
+            .with_parallelism(2, 1).with_tb_windows(WIN, SLIDE).build()
+        return wf.WinFarmBuilder(inner).with_parallelism(par).build()
+    else:
+        raise ValueError(kind)
+    b = (b.with_cb_windows(WIN, SLIDE) if win_type == WinType.CB
+         else b.with_tb_windows(WIN, SLIDE))
+    return b.build()
+
+
+def expected_total(per_key, n_keys, win, slide):
+    """Sum over all keys of all window sums with EOS flush."""
+    total = 0.0
+    g = 0
+    while g * slide < per_key:
+        total += sum(v for v in range(per_key)
+                     if g * slide <= v < g * slide + win)
+        g += 1
+    return total * n_keys
+
+
+@pytest.mark.parametrize("kind", ["wf", "kf", "kff", "pf", "wmr",
+                                  "kf+pf", "wf+wmr"])
+@pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
+def test_matrix_randomized_parallelism(kind, win_type):
+    """The core oracle: run twice with different random parallelisms,
+    totals must match each other and the sequential expectation."""
+    # the parallel prefix destroys per-key order, so the matrix runs in
+    # DETERMINISTIC mode (ordering collectors); the DEFAULT-mode
+    # renumbering path has its own dedicated test below with tumbling
+    # windows, whose totals are arrival-order invariant.
+    mode = Mode.DETERMINISTIC
+    totals = []
+    for trial in range(2):
+        rnd = random.Random(100 * trial + hash(kind) % 50)
+        sink = SumSink()
+        g = wf.PipeGraph("mp", mode)
+        fil, fm, mp_ = prefix_ops(rnd)
+        op = build_window_op(kind, win_type, rnd.randint(1, 4), rnd)
+        pipe = g.add_source(wf.SourceBuilder(
+            ordered_keyed_stream(N_KEYS, PER_KEY)).build())
+        if mode == Mode.DEFAULT:
+            pipe.chain(fil).chain(fm).chain(mp_)
+        else:
+            pipe.add(fil).add(fm).add(mp_)
+        pipe.add(op).add_sink(wf.SinkBuilder(sink).build())
+        g.run()
+        totals.append(sink.total)
+    assert totals[0] == totals[1] == expected_total(PER_KEY, N_KEYS, WIN,
+                                                    SLIDE)
+
+
+@pytest.mark.parametrize("kind", ["kf", "kff"])
+def test_string_keys(kind):
+    """_string variants: non-integral keys through hash routing."""
+    sink = SumSink()
+    g = wf.PipeGraph("mp", Mode.DEFAULT)
+    src = pareto_ooo_stream(N_KEYS, PER_KEY, jitter=1, key_type="str")
+    op = build_window_op(kind, WinType.CB, 3, random.Random(1))
+    g.add_source(wf.SourceBuilder(src).build()) \
+        .add(op).add_sink(wf.SinkBuilder(sink).build())
+    g.run()
+    assert sink.total == expected_total(PER_KEY, N_KEYS, WIN, SLIDE)
+
+
+def test_probabilistic_mode_out_of_order():
+    """_prob variants: K-slack collectors on an out-of-order stream.
+    The oracle is statistical: results cover nearly the whole stream
+    and any excess drops are counted by the graph."""
+    sink = SumSink()
+    g = wf.PipeGraph("prob", Mode.PROBABILISTIC)
+    src = pareto_ooo_stream(N_KEYS, PER_KEY, jitter=4)
+    op = wf.KeyFarmBuilder(sum_win).with_parallelism(3) \
+        .with_tb_windows(50, 25).build()
+    g.add_source(wf.SourceBuilder(src).build()) \
+        .add(op).add_sink(wf.SinkBuilder(sink).build())
+    g.run()
+    assert sink.count > 0
+    # every processed tuple contributes; drops are accounted centrally
+    assert g.get_num_dropped_tuples() >= 0
+    full = expected_sum_of_events(src.events, 50, 25)
+    assert sink.total >= 0.5 * full
+
+
+def expected_sum_of_events(events, win, slide):
+    per_key = {}
+    for k, tid, ts in events:
+        per_key.setdefault(k, []).append((ts, float(tid)))
+    total = 0.0
+    for k, recs in per_key.items():
+        max_ts = max(ts for ts, _ in recs)
+        g = 0
+        while g * slide <= max_ts:
+            total += sum(v for ts, v in recs
+                         if g * slide <= ts < g * slide + win)
+            g += 1
+    return total
+
+
+def test_triggering_delay_absorbs_disorder_exact():
+    """A triggering delay covering the source's maximum disorder makes
+    TB windows exact on an out-of-order stream (the DELAYED state,
+    window.hpp:114): windows hold their fire until the delay passes, so
+    stragglers still land inside their windows."""
+    totals = []
+    for par in (1, 3):
+        sink = SumSink()
+        g = wf.PipeGraph("det", Mode.DEFAULT)
+        src = pareto_ooo_stream(N_KEYS, PER_KEY, jitter=4, seed=7)
+        op = wf.KeyFarmBuilder(sum_win).with_parallelism(par) \
+            .with_tb_windows(50, 25, 500).build()
+        g.add_source(wf.SourceBuilder(src).build()) \
+            .add(op).add_sink(wf.SinkBuilder(sink).build())
+        g.run()
+        totals.append(sink.total)
+    assert totals[0] == totals[1]
+    src = pareto_ooo_stream(N_KEYS, PER_KEY, jitter=4, seed=7)
+    assert totals[0] == expected_sum_of_events(src.events, 50, 25)
+
+
+def test_deterministic_mode_cross_channel_exact():
+    """DETERMINISTIC mode restores order ACROSS channels: two in-order
+    source replicas with interleaved timestamps produce exact results
+    at any parallelism (the ordering collector's contract)."""
+    per_src = 40
+
+    def make_src():
+        state = {}
+
+        def fn(shipper, ctx):
+            ridx = ctx.get_replica_index()
+            st = state.setdefault(ridx, {"i": 0})
+            i = st["i"]
+            if i >= per_src:
+                return False
+            key = i % N_KEYS
+            tid = i // N_KEYS
+            # replica 0: even ts, replica 1: odd ts -- interleaved
+            shipper.push(BasicRecord(key, tid, 2 * tid + ridx,
+                                     float(tid)))
+            st["i"] = i + 1
+            return True
+
+        return fn
+
+    totals = []
+    for par in (1, 3):
+        sink = SumSink()
+        g = wf.PipeGraph("det2", Mode.DETERMINISTIC)
+        src = wf.SourceBuilder(make_src()).with_parallelism(2).build()
+        op = wf.KeyFarmBuilder(sum_win).with_parallelism(par) \
+            .with_tb_windows(8, 4).build()
+        g.add_source(src).add(op).add_sink(wf.SinkBuilder(sink).build())
+        g.run()
+        totals.append(sink.total)
+    events = []
+    for ridx in range(2):
+        for i in range(per_src):
+            events.append((i % N_KEYS, i // N_KEYS, 2 * (i // N_KEYS) + ridx))
+    assert totals[0] == totals[1] == expected_sum_of_events(events, 8, 4)
+
+
+@pytest.mark.parametrize("kind", ["kf", "kff"])
+def test_cb_default_renumbering_tumbling(kind):
+    """DEFAULT mode + CB tumbling windows behind a parallel prefix:
+    per-key renumbering (win_seq.hpp:342-347) assigns arrival-dense ids,
+    and tumbling sums are invariant to arrival order."""
+    totals = []
+    for trial in range(2):
+        rnd = random.Random(trial)
+        sink = SumSink()
+        g = wf.PipeGraph("renum", Mode.DEFAULT)
+        fil, fm, mp_ = prefix_ops(rnd)
+        if kind == "kf":
+            op = wf.KeyFarmBuilder(sum_win).with_parallelism(3) \
+                .with_cb_windows(10, 10).build()
+        else:
+            op = wf.KeyFFATBuilder(
+                lambda t, r: setattr(r, "value", t.value),
+                lambda a, c, o: setattr(o, "value", a.value + c.value)) \
+                .with_parallelism(3).with_cb_windows(10, 10).build()
+        g.add_source(wf.SourceBuilder(
+            ordered_keyed_stream(N_KEYS, PER_KEY)).build()) \
+            .add(fil).add(fm).add(mp_) \
+            .add(op).add_sink(wf.SinkBuilder(sink).build())
+        g.run()
+        totals.append(sink.total)
+    assert totals[0] == totals[1] == expected_total(PER_KEY, N_KEYS, 10, 10)
