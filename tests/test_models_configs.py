@@ -1,0 +1,71 @@
+"""The five BASELINE configs + the Yahoo flagship, end to end (small)."""
+import numpy as np
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core import Mode
+from windflow_tpu.models import configs as C
+
+
+def run_config(fn, **kw):
+    g = wf.PipeGraph("cfg", Mode.DEFAULT)
+    coll = fn(g, **kw)
+    g.run()
+    return coll
+
+
+def test_config1_cpu_multipipe():
+    coll = run_config(C.config_cpu_multipipe, n_events=2000, n_keys=4,
+                      win=50)
+    # doubled values, tumbling windows + flush: total = 2 * sum of values
+    per_key = 2000 // 4
+    assert coll.total == 2 * 4 * sum(range(per_key))
+
+
+def test_config2_win_seq_tpu():
+    coll = run_config(C.config_win_seq_tpu, n_events=20000, n_keys=8,
+                      win=256, slide=128, batch=64)
+    assert coll.count > 0
+
+
+def test_config3_pane_farm_tpu():
+    coll = run_config(C.config_pane_farm_tpu, n_events=20000, n_keys=8,
+                      win=256, slide=128, batch=64)
+    assert coll.count > 0
+
+
+def test_config4_key_farm_tpu():
+    coll = run_config(C.config_key_farm_tpu, n_events=20000, n_keys=16,
+                      win=256, slide=128, batch=64, parallelism=2)
+    assert coll.count > 0
+
+
+def test_config5_yahoo():
+    coll = run_config(C.config_yahoo, n_events=50000, n_ads=100,
+                      n_campaigns=10, win_len=2000, slide_len=2000,
+                      batch_size=8192, device_batch=64)
+    # windowed view-counts sum to the number of view events
+    from windflow_tpu.models.yahoo import VIEW, synth_events
+    views = 0
+    i = 0
+    while i < 50000:
+        n = min(8192, 50000 - i)
+        ev = synth_events(n, 100, seed=i, ts_start=i)
+        views += int((ev["event_type"] == VIEW).sum())
+        i += n
+    assert coll.total == views
+
+
+def test_yahoo_step_fn_counts():
+    from windflow_tpu.models.yahoo import (VIEW, example_step_args,
+                                           make_step)
+    fn = make_step(10, 4, 256)
+    args = example_step_args(n_events=1024, n_ads=50, n_campaigns=10,
+                             n_windows=4, win_len=256)
+    out = np.asarray(fn(*args))
+    camp, ad, et, ts, _ = args
+    assert out.sum() == (et == VIEW).sum()
+    # spot-check one cell
+    c, w = 3, 1
+    mask = (camp[ad] == c) & (et == VIEW) & (ts // 256 == w)
+    assert out[c, w] == mask.sum()

@@ -1,0 +1,132 @@
+"""Minimal dashboard server: receives the framed TCP protocol.
+
+The reference's dashboard directory is empty in its snapshot (a Java
+Spring + React app upstream, README "Web Dashboard"); the wire protocol
+is fully specified by monitoring.hpp (SURVEY.md §3.5).  This module
+provides a self-contained receiver speaking that protocol so traced
+graphs have somewhere to report: it stores the latest stats per app and
+can serve them as JSON over HTTP for any front-end.
+
+Run standalone:  python -m windflow_tpu.monitoring.dashboard
+(ingest on :20207, HTTP snapshot on :20208/apps)
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+
+class DashboardServer(threading.Thread):
+    """Accepts many apps; keeps per-app diagram + latest report."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 20207):
+        super().__init__(name="windflow-dashboard", daemon=True)
+        self.server = socket.create_server((host, port))
+        self.port = self.server.getsockname()[1]
+        self.lock = threading.Lock()
+        self.apps: Dict[int, dict] = {}
+        self._next_id = 1
+        self._stop = threading.Event()
+
+    # -- framed protocol (mirror of monitoring.hpp:232-313) ---------------
+    @staticmethod
+    def _recv_exact(conn, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf += chunk
+        return buf
+
+    def _serve_conn(self, conn) -> None:
+        app_id = None
+        try:
+            with conn:
+                mtype, length = struct.unpack(
+                    "<ii", self._recv_exact(conn, 8))
+                if mtype != 0:
+                    return
+                diagram = self._recv_exact(conn, length).decode(
+                    errors="replace")
+                with self.lock:
+                    app_id = self._next_id
+                    self._next_id += 1
+                    self.apps[app_id] = {"diagram": diagram, "report": None,
+                                         "reports_received": 0,
+                                         "active": True}
+                conn.sendall(struct.pack("<i", app_id))
+                while True:
+                    mtype, aid, length = struct.unpack(
+                        "<iii", self._recv_exact(conn, 12))
+                    if mtype == 2:
+                        with self.lock:
+                            if aid in self.apps:
+                                self.apps[aid]["active"] = False
+                        return
+                    payload = self._recv_exact(conn, length)
+                    with self.lock:
+                        if aid in self.apps:
+                            try:
+                                self.apps[aid]["report"] = json.loads(payload)
+                            except json.JSONDecodeError:
+                                pass
+                            self.apps[aid]["reports_received"] += 1
+        except (ConnectionError, OSError, struct.error):
+            if app_id is not None:
+                with self.lock:
+                    if app_id in self.apps:
+                        self.apps[app_id]["active"] = False
+
+    def run(self) -> None:
+        self.server.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.server.close()
+        self.join(timeout=2)
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            return json.loads(json.dumps(self.apps))
+
+
+def serve_http(dash: DashboardServer, port: int = 20208):
+    """Expose the dashboard state as JSON over HTTP."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json.dumps(dash.snapshot()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+if __name__ == "__main__":
+    dash = DashboardServer()
+    dash.start()
+    serve_http(dash)
+    print(f"windflow dashboard: ingest :{dash.port}, http :20208/apps")
+    dash.join()

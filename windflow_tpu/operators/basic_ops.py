@@ -125,6 +125,12 @@ class AccumulatorLogic(_ReplicaLogic):
         out.set_control_fields(key, tid, ts)
         emit(out)
 
+    def state_dict(self):
+        return {"state": self.state}
+
+    def load_state(self, st):
+        self.state = st["state"]
+
 
 class SinkLogic(_ReplicaLogic):
     def __init__(self, fn, parallelism, replica_index, closing_func):

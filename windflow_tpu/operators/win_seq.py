@@ -29,6 +29,14 @@ from ..runtime.node import EOSMarker, NodeLogic
 from .base import Operator, StageSpec
 
 
+def _sort_by_id(t):
+    return t.get_control_fields()[1]
+
+
+def _sort_by_ts(t):
+    return t.get_control_fields()[2]
+
+
 class _KeyDescriptor:
     """Per-key state (win_seq.hpp:98-127)."""
 
@@ -69,10 +77,10 @@ class WinSeqLogic(NodeLogic):
         self.context = RuntimeContext(parallelism, replica_index)
         base = 3  # (gwid, data, result)
         self.win_func = with_context(win_func, base, self.context)
-        sort_key = ((lambda t: t.get_control_fields()[1])
-                    if win_type == WinType.CB
-                    else (lambda t: t.get_control_fields()[2]))
-        self._sort_key = sort_key
+        # module-level sort keys keep per-key state picklable
+        # (utils/checkpoint.py)
+        self._sort_key = (_sort_by_id if win_type == WinType.CB
+                          else _sort_by_ts)
         self.keys: Dict[Any, _KeyDescriptor] = {}
         self.ignored_tuples = 0
 
@@ -187,6 +195,13 @@ class WinSeqLogic(NodeLogic):
     def svc_end(self):
         if self.closing_func is not None:
             self.closing_func(self.context)
+
+    def state_dict(self):
+        return {"keys": self.keys, "ignored": self.ignored_tuples}
+
+    def load_state(self, state):
+        self.keys = state["keys"]
+        self.ignored_tuples = state["ignored"]
 
 
 class WinSeq(Operator):

@@ -174,6 +174,41 @@ class WinSeqFFATLogic(NodeLogic):
         if self.closing_func is not None:
             self.closing_func(self.context)
 
+    def state_dict(self):
+        # FlatFAT trees hold closures (combine); snapshot their live
+        # values and rebuild the trees on load
+        snap = {}
+        for key, st in self.keys.items():
+            vals = []
+            idx = st.tree.front
+            for _ in range(st.tree.count):
+                vals.append(st.tree.tree[st.tree.n + idx])
+                idx = (idx + 1) % st.tree.n
+            snap[key] = {
+                "tree_values": vals, "capacity": st.tree.n,
+                "content_keys": list(st.content_keys),
+                "pending_keys": list(st.pending_keys),
+                "pending_vals": list(st.pending_vals),
+                "next_lwid": st.next_lwid, "max_id": st.max_id,
+                "renumber_next": st.renumber_next,
+            }
+        return {"keys": snap, "ignored": self.ignored_tuples}
+
+    def load_state(self, state):
+        self.keys.clear()
+        for key, snap in state["keys"].items():
+            st = _FFATKeyState(self._new_tree(snap["capacity"]))
+            if snap["tree_values"]:
+                st.tree.insert_bulk(snap["tree_values"])
+            st.content_keys = list(snap["content_keys"])
+            st.pending_keys = list(snap["pending_keys"])
+            st.pending_vals = list(snap["pending_vals"])
+            st.next_lwid = snap["next_lwid"]
+            st.max_id = snap["max_id"]
+            st.renumber_next = snap["renumber_next"]
+            self.keys[key] = st
+        self.ignored_tuples = state["ignored"]
+
 
 class WinSeqFFAT(Operator):
     def __init__(self, lift_func, combine_func, win_len, slide_len, win_type,

@@ -44,6 +44,15 @@ class NodeLogic:
     def svc_end(self) -> None:
         pass
 
+    # -- checkpoint hooks (utils/checkpoint.py; absent in the reference,
+    # SURVEY.md §5 "Checkpoint / resume") ---------------------------------
+    def state_dict(self):
+        """Picklable snapshot of this replica's state; None = stateless."""
+        return None
+
+    def load_state(self, state) -> None:
+        raise NotImplementedError(f"{type(self).__name__} is stateless")
+
 
 class Outlet:
     """Output side of a node: an emitter routing items to destination

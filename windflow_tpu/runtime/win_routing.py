@@ -59,7 +59,63 @@ class WFEmitter(Emitter):
         self.slide_outer = slide_outer
         self.tracker = _LastTupleTracker(win_type)
 
+    def _emit_batch(self, batch, send_to):
+        """Columnar multicast: per destination d, select the rows whose
+        window range [first_w, last_w] includes a window owned by d
+        (vectorized form of wf_destinations)."""
+        import numpy as np
+        from ..core.tuples import BasicRecord
+        keys = batch.key
+        ids = batch.id if self.win_type == WinType.CB else batch.ts
+        h = np.abs(keys)
+        first_gwid = (self.id_outer - (h % self.n_outer)
+                      + self.n_outer) % self.n_outer
+        initial = first_gwid * self.slide_outer
+        if self.role in (Role.WLQ, Role.REDUCE):
+            initial = np.zeros_like(initial)
+        rel = ids - initial
+        ok = rel >= 0
+        win, slide, P = self.win_len, self.slide_len, self.pardegree
+        if win >= slide:
+            first_w = np.maximum(0, -(-(rel + 1 - win) // slide))
+            last_w = -(-(rel + 1) // slide) - 1
+        else:  # hopping
+            n = rel // slide
+            inside = (rel >= n * slide) & (rel < n * slide + win)
+            ok &= inside
+            first_w = last_w = n
+        span = last_w - first_w + 1
+        start_dst = h % P
+        # track per-key last tuples for the EOS markers (vectorized:
+        # lexsort groups keys with ascending field; the last row of each
+        # group is that key's maximum)
+        if ok.any():
+            ks, fs = keys[ok], ids[ok]
+            bi, bt = batch.id[ok], batch.ts[ok]
+            order = np.lexsort((fs, ks))
+            ks_s = ks[order]
+            last_of_group = np.nonzero(
+                np.append(np.diff(ks_s) != 0, True))[0]
+            for j in last_of_group:
+                row = order[j]
+                key = ks_s[j].item()
+                field = int(fs[row])
+                prev = self.tracker.last.get(key)
+                if prev is None or field > prev[0]:
+                    self.tracker.last[key] = (field, BasicRecord(
+                        key, int(bi[row]), int(bt[row])))
+        for d in range(P):
+            k = (d - start_dst) % P
+            mask = ok & ((span >= P) | (((k - first_w) % P) <= (last_w
+                                                               - first_w)))
+            if mask.any():
+                send_to(d, batch.take(mask))
+
     def emit(self, item, send_to):
+        from ..core.tuples import TupleBatch
+        if isinstance(item, TupleBatch):
+            self._emit_batch(item, send_to)
+            return
         if isinstance(item, EOSMarker):
             for d in range(self.pardegree):
                 send_to(d, item)

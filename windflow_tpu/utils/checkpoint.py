@@ -1,0 +1,55 @@
+"""Checkpoint / resume of operator state.
+
+The reference has **no** checkpointing (SURVEY.md §5: "Absent. No
+serialization of operator state exists"); windflow_tpu isolates it as a
+policy layer, as the survey recommends.  Mechanism: every stateful
+NodeLogic exposes ``state_dict() / load_state()`` (pickle-friendly
+snapshots of per-key window state); this module walks a PipeGraph and
+saves/restores every replica's state.
+
+Scope and contract:
+* checkpoint between items -- the runtime only calls these while a
+  node is quiescent (before start or after wait_end; a live barrier
+  protocol is future work);
+* user record/result types must be picklable.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List
+
+
+def graph_state(graph) -> Dict[str, Any]:
+    """Collect every replica's state_dict, keyed by node name."""
+    out = {}
+    for node in graph._all_nodes():
+        logic = node.logic
+        getter = getattr(logic, "state_dict", None)
+        if getter is None:
+            continue
+        st = getter()
+        if st is not None:
+            out[node.name] = st
+    return out
+
+
+def save_graph(graph, path: str) -> None:
+    with open(path, "wb") as f:
+        pickle.dump(graph_state(graph), f)
+
+
+def restore_graph(graph, path: str) -> int:
+    """Load state into a structurally identical graph (same operator
+    names/parallelisms).  Returns the number of replicas restored."""
+    with open(path, "rb") as f:
+        states = pickle.load(f)
+    n = 0
+    for node in graph._all_nodes():
+        st = states.get(node.name)
+        if st is None:
+            continue
+        loader = getattr(node.logic, "load_state", None)
+        if loader is not None:
+            loader(st)
+            n += 1
+    return n
