@@ -77,13 +77,16 @@ def run_tpu_graph(n_events, warmup=False):
     g = wf.PipeGraph("bench", wf.Mode.DEFAULT)
     op = KeyFarmTPU("sum", WIN, SLIDE, wf.WinType.TB,
                     parallelism=KEY_PARALLELISM, batch_len=DEVICE_BATCH,
-                    emit_batches=True)
+                    emit_batches=True, max_buffer_elems=1 << 22)
     g.add_source(BatchSource(source, SOURCE_PARALLELISM)) \
         .add(op).add_sink(Sink(sink))
     t0 = time.perf_counter()
     g.run()
     dt = time.perf_counter() - t0
-    return n_events / dt, got["windows"], dt
+    lat = []
+    for node in g._all_nodes():
+        lat.extend(getattr(node.logic, "latency_samples", []))
+    return n_events / dt, got["windows"], dt, lat
 
 
 def run_host_baseline(n_events):
@@ -126,10 +129,12 @@ def run_host_baseline(n_events):
 def main():
     # warmup: populate jit caches with the shapes the timed run uses
     run_tpu_graph(min(1_000_000, N_EVENTS // 8), warmup=True)
-    rate, windows, dt = run_tpu_graph(N_EVENTS)
+    rate, windows, dt, lat = run_tpu_graph(N_EVENTS)
     host_rate = run_host_baseline(HOST_BASELINE_EVENTS)
+    p99 = np.percentile(lat, 99) * 1e3 if lat else float("nan")
     print(f"[bench] tpu: {rate:,.0f} tuples/s ({windows} windows in "
-          f"{dt:.2f}s); host reference-style: {host_rate:,.0f} tuples/s",
+          f"{dt:.2f}s, p99 batch latency {p99:.1f} ms); "
+          f"host reference-style: {host_rate:,.0f} tuples/s",
           file=sys.stderr)
     print(json.dumps({
         "metric": "keyed sliding-window aggregate throughput",

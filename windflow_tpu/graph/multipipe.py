@@ -242,6 +242,18 @@ class MultiPipe:
         FlatMap/Sink)."""
         self._check_open()
         logics = op.chain_logics()
+        if logics is None and self.graph.mode == Mode.DEFAULT \
+                and len(self.tails) == 1:
+            # single-replica fusion: any single-stage operator with one
+            # replica and no collector can run inline in the tail thread
+            stages = op.stages()
+            if (len(stages) == 1 and len(stages[0].replicas) == 1
+                    and stages[0].collector is None):
+                self._mark_used(op)
+                self.tails[0].logic = ChainedLogic(self.tails[0].logic,
+                                                   stages[0].replicas[0])
+                self._op_names.append(f"{op.name}(chained)")
+                return self
         if (logics is None or len(logics) != len(self.tails)
                 or self.graph.mode != Mode.DEFAULT):
             return self.add(op)

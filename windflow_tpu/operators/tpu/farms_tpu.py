@@ -39,7 +39,8 @@ from .win_seq_tpu import DEFAULT_BATCH_LEN, WinSeqTPULogic
 def _tpu_replicas(win_kind, win_len, slide_len, win_type, par, *,
                   batch_len, triggering_delay, result_factory, value_of,
                   enclosing: WinOperatorConfig, role: Role,
-                  farm_kind: str, renumbering=False, emit_batches=False):
+                  farm_kind: str, renumbering=False, emit_batches=False,
+                  max_buffer_elems=1 << 19):
     """Build the worker set with the same config conventions as the CPU
     farms (win_farm.hpp:175 / key_farm worker configs)."""
     reps = []
@@ -62,7 +63,8 @@ def _tpu_replicas(win_kind, win_len, slide_len, win_type, par, *,
             config=cfg, role=role,
             map_indexes=(i, par) if role == Role.MAP else (0, 1),
             parallelism=par, replica_index=i, renumbering=renumbering,
-            value_of=value_of, emit_batches=emit_batches))
+            value_of=value_of, emit_batches=emit_batches,
+            max_buffer_elems=max_buffer_elems))
     return reps
 
 
@@ -85,7 +87,8 @@ class KeyFarmTPU(_TPUWinOp):
                  parallelism=1, batch_len=DEFAULT_BATCH_LEN,
                  triggering_delay=0, name="key_farm_tpu",
                  result_factory=BasicRecord, value_of=None,
-                 config: WinOperatorConfig = None, emit_batches=False):
+                 config: WinOperatorConfig = None, emit_batches=False,
+                 max_buffer_elems=1 << 19):
         super().__init__(name, parallelism, RoutingMode.KEYBY,
                          Pattern.KEY_FARM_TPU, win_type)
         self.args = (win_kind, win_len, slide_len, win_type)
@@ -95,6 +98,7 @@ class KeyFarmTPU(_TPUWinOp):
         self.value_of = value_of
         self.config = config or WinOperatorConfig(0, 1, 0, 0, 1, 0)
         self.emit_batches = emit_batches
+        self.max_buffer_elems = max_buffer_elems
 
     def stages(self):
         kind, win_len, slide_len, win_type = self.args
@@ -103,7 +107,8 @@ class KeyFarmTPU(_TPUWinOp):
             batch_len=self.batch_len, triggering_delay=self.triggering_delay,
             result_factory=self.result_factory, value_of=self.value_of,
             enclosing=self.config, role=Role.SEQ, farm_kind="kf",
-            renumbering=self._renumbering, emit_batches=self.emit_batches)
+            renumbering=self._renumbering, emit_batches=self.emit_batches,
+            max_buffer_elems=self.max_buffer_elems)
         return [StageSpec(self.name, reps, KFEmitter(self.parallelism),
                           self.routing, ordering_mode=self._ordering())]
 

@@ -100,6 +100,10 @@ class WinSeqTPULogic(NodeLogic):
         # win_seq_gpu.hpp:574-592)
         self.max_buffer_elems = max_buffer_elems
         self._buffered_since_launch = 0
+        # window-result latency samples (descriptor creation -> emission),
+        # feeding the p99 metric of BASELINE.md
+        self.latency_samples: List[float] = []
+        self._batch_birth: Optional[float] = None
 
     # -- per-key helpers ---------------------------------------------------
     def _key_state(self, key) -> _TPUKeyState:
@@ -154,9 +158,12 @@ class WinSeqTPULogic(NodeLogic):
     def _flush_pending(self, emit) -> None:
         if self.pending is None:
             return
-        handle, descs = self.pending
+        handle, descs, birth = self.pending
         self.pending = None
         results = handle.block()
+        import time as _time
+        if len(self.latency_samples) < 100_000:
+            self.latency_samples.append(_time.perf_counter() - birth)
         if self.emit_batches and self.role == Role.SEQ:
             # columnar emission: one result TupleBatch per device batch
             out = TupleBatch({
@@ -270,7 +277,10 @@ class WinSeqTPULogic(NodeLogic):
         if use_panes and kind == "count":
             eng = self._count_engine()
         handle = eng.compute({"value": flat_vals}, starts, ends, gwids)
-        self.pending = (handle, descs)
+        import time as _time
+        self.pending = (handle, descs,
+                        self._batch_birth or _time.perf_counter())
+        self._batch_birth = None
         self.launched_batches += 1
         self._buffered_since_launch = 0
 
@@ -302,6 +312,9 @@ class WinSeqTPULogic(NodeLogic):
             gwid = wa.gwid_of_lwid(first_gwid, lwid, cfg)
             rts = (gwid * self.slide_len + self.win_len - 1
                    if self.win_type == WinType.TB else -1)  # CB: at launch
+            if not self.descriptors:
+                import time as _time
+                self._batch_birth = _time.perf_counter()
             self.descriptors.append((key, gwid, start, end, rts, key))
             st.next_fire += 1
             if len(self.descriptors) >= self.batch_len:
@@ -315,9 +328,12 @@ class WinSeqTPULogic(NodeLogic):
         ids = batch.id if self.win_type == WinType.CB else batch.ts
         vals = batch["value"]
         tss = batch.ts
-        order = np.argsort(keys, kind="stable")
-        keys_s, ids_s = keys[order], ids[order]
-        vals_s, tss_s = vals[order], tss[order]
+        if len(keys) > 1 and np.all(keys[:-1] <= keys[1:]):
+            keys_s, ids_s, vals_s, tss_s = keys, ids, vals, tss
+        else:
+            order = np.argsort(keys, kind="stable")
+            keys_s, ids_s = keys[order], ids[order]
+            vals_s, tss_s = vals[order], tss[order]
         # group boundaries on the sorted key column (cheaper than
         # np.unique: one diff over the sorted array)
         edges = np.nonzero(np.diff(keys_s))[0] + 1
