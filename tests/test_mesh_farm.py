@@ -1,0 +1,75 @@
+"""KeyFarmMesh: the multi-chip Key_Farm operator on the virtual mesh."""
+import threading
+
+import numpy as np
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core import BasicRecord, Mode, WinType
+from windflow_tpu.core.tuples import TupleBatch
+from windflow_tpu.operators.batch_ops import BatchSource
+from windflow_tpu.operators.basic_ops import Sink
+from windflow_tpu.operators.tpu.mesh_farm import KeyFarmMesh
+from windflow_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8, win_axis=1)  # 8 key shards
+
+
+def oracle(per_key, win, slide):
+    out = {}
+    g = 0
+    while g * slide < per_key:
+        out[g] = float(sum(v for v in range(per_key)
+                           if g * slide <= v < g * slide + win))
+        g += 1
+    return out
+
+
+@pytest.mark.parametrize("win,slide", [(12, 4), (8, 8)])
+def test_mesh_farm_matches_oracle(mesh, win, slide):
+    n_keys, per_key = 16, 48
+    state = {"sent": 0}
+
+    def source(ctx):
+        i = state["sent"]
+        total = n_keys * per_key
+        if i >= total:
+            return None
+        n = min(256, total - i)
+        idx = i + np.arange(n)
+        state["sent"] = i + n
+        return TupleBatch({
+            "key": idx % n_keys,
+            "id": idx // n_keys,
+            "ts": idx // n_keys,
+            "value": (idx // n_keys).astype(np.float64),
+        })
+
+    got = {}
+    lock = threading.Lock()
+
+    def sink(item):
+        if item is None:
+            return
+        with lock:
+            if isinstance(item, TupleBatch):
+                for j in range(len(item)):
+                    got.setdefault(int(item.key[j]), {})[
+                        int(item.id[j])] = float(item["value"][j])
+
+    g = wf.PipeGraph("mesh", Mode.DEFAULT)
+    op = KeyFarmMesh(mesh, win, slide, WinType.TB, batch_windows=16)
+    g.add_source(BatchSource(source)).add(op).add_sink(Sink(sink))
+    g.run()
+    expect = oracle(per_key, win, slide)
+    assert set(got) == set(range(n_keys))
+    for k in got:
+        assert got[k] == expect, (k, got[k])
+
+
+def test_mesh_farm_uses_all_shards(mesh):
+    op = KeyFarmMesh(mesh, 8, 4, WinType.TB)
+    assert op.engine.n_key_shards == 8

@@ -129,6 +129,31 @@ class ShardedWindowEngine:
         """One sharded streaming step; see _sharded_programs."""
         return self._step(values, starts, ends, stripe_values, pane_values)
 
+    def compute_kf(self, values, starts, ends):
+        """Key-sharded window sums only (the Key_Farm-across-chips path
+        used by operators.tpu.mesh_farm).  ``values`` is [K_shards, T],
+        extents are [K_shards, B]; everything sharded over 'key'."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if not hasattr(self, "_kf_only"):
+            import jax.numpy as jnp
+
+            def kf_shard(v, s, e):
+                c = jnp.concatenate([jnp.zeros((1, 1), v.dtype),
+                                     jnp.cumsum(v, axis=1)], axis=1)
+                return jnp.take_along_axis(c, e, axis=1) - \
+                    jnp.take_along_axis(c, s, axis=1)
+
+            self._kf_only = jax.jit(jax.shard_map(
+                kf_shard, mesh=self.mesh,
+                in_specs=(P("key", None), P("key", None), P("key", None)),
+                out_specs=P("key", None), check_vma=False))
+        sh = NamedSharding(self.mesh, P("key", None))
+        return self._kf_only(jax.device_put(values, sh),
+                             jax.device_put(starts, sh),
+                             jax.device_put(ends, sh))
+
     def example_inputs(self, T: int = 64, B: int = 8, keys_per_shard: int = 2,
                        stripe_w: int = 8, panes_per_shard: int = 4,
                        pane_len: int = 4):
