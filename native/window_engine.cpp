@@ -1,0 +1,241 @@
+// Native columnar window engine: the C++ batch assembler of the device
+// window path (SURVEY.md §7 step 4: "batch assembler (pinned host
+// buffers -> PJRT device buffers)" belongs in the native runtime).
+//
+// Covers the hot standalone case of Win_Seq_TPU (role SEQ, identity
+// WinOperatorConfig, int64 keys, builtin 'sum' with pane pre-reduction):
+// ingest columnar batches, maintain per-key sorted series, detect fired
+// windows, and stage pane-reduced flat buffers + extents for one XLA
+// launch.  The Python engine (operators/tpu/win_seq_tpu.py) delegates
+// here when the workload matches and falls back otherwise (roles,
+// custom functors, string keys).
+//
+// GIL-free: every entry point only touches caller-provided arrays and
+// internal state; Python calls via ctypes release the GIL.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using i64 = long long;
+
+struct KeyState {
+    std::vector<i64> ids;     // sort keys (tuple id for CB, ts for TB)
+    std::vector<i64> ts;
+    std::vector<double> vals;
+    i64 next_fire = 0;        // next window (lwid) to fire
+    i64 opened_max = -1;
+    i64 max_id = -1;
+    bool needs_sort = false;
+};
+
+struct Desc {
+    i64 key, lwid, start, end;
+};
+
+struct Engine {
+    i64 win, slide, delay;
+    bool is_tb;
+    i64 pane;                 // gcd(win, slide)
+    std::unordered_map<i64, KeyState> keys;
+    std::vector<Desc> ready;
+    // staging buffers (valid until the next flush)
+    std::vector<double> st_vals;
+    std::vector<i64> st_starts, st_ends, st_keys, st_gwids, st_rts;
+
+    Engine(i64 w, i64 s, bool tb, i64 d)
+        : win(w), slide(s), delay(tb ? d : 0), is_tb(tb),
+          pane(std::gcd(w, s)) {}
+
+    void ingest_key(i64 key, const i64* ids, const i64* tss,
+                    const double* vals, i64 n) {
+        KeyState& st = keys[key];
+        i64 accept_from = st.next_fire > 0
+            ? (st.next_fire - 1) * slide + win : 0;
+        for (i64 j = 0; j < n; ++j) {
+            i64 id = ids[j];
+            if (id < accept_from) continue;  // behind the fired frontier
+            if (!st.ids.empty() && id < st.ids.back()) st.needs_sort = true;
+            st.ids.push_back(id);
+            st.ts.push_back(tss[j]);
+            st.vals.push_back(vals[j]);
+            if (id > st.max_id) st.max_id = id;
+        }
+        if (st.max_id >= 0) {
+            i64 last_w;
+            if (win >= slide) {
+                last_w = (st.max_id + 1 + slide - 1) / slide - 1;
+            } else {
+                i64 nn = st.max_id / slide;
+                last_w = (st.max_id < nn * slide + win) ? nn : -1;
+            }
+            if (last_w > st.opened_max) st.opened_max = last_w;
+        }
+        while (true) {
+            i64 end = st.next_fire * slide + win;
+            if (st.max_id < end + delay || st.next_fire > st.opened_max)
+                break;
+            ready.push_back(Desc{key, st.next_fire,
+                                 st.next_fire * slide, end});
+            ++st.next_fire;
+        }
+    }
+
+    void sort_key(KeyState& st) {
+        if (!st.needs_sort) return;
+        std::vector<std::size_t> idx(st.ids.size());
+        std::iota(idx.begin(), idx.end(), 0);
+        std::stable_sort(idx.begin(), idx.end(), [&](auto a, auto b) {
+            return st.ids[a] < st.ids[b];
+        });
+        std::vector<i64> ids2(st.ids.size()), ts2(st.ids.size());
+        std::vector<double> v2(st.ids.size());
+        for (std::size_t j = 0; j < idx.size(); ++j) {
+            ids2[j] = st.ids[idx[j]];
+            ts2[j] = st.ts[idx[j]];
+            v2[j] = st.vals[idx[j]];
+        }
+        st.ids.swap(ids2);
+        st.ts.swap(ts2);
+        st.vals.swap(v2);
+        st.needs_sort = false;
+    }
+
+    // Stage up to max_windows ready windows as pane partial sums.
+    // Returns the number staged.
+    i64 flush(i64 max_windows) {
+        st_vals.clear();
+        st_starts.clear();
+        st_ends.clear();
+        st_keys.clear();
+        st_gwids.clear();
+        st_rts.clear();
+        if (ready.empty()) return 0;
+        i64 take = std::min<i64>(max_windows, (i64)ready.size());
+        // group taken descriptors per key (they were appended per key
+        // in order, but batches interleave keys)
+        std::unordered_map<i64, std::pair<i64, i64>> span;  // key->min,max
+        for (i64 d = 0; d < take; ++d) {
+            const Desc& ds = ready[d];
+            auto it = span.find(ds.key);
+            if (it == span.end()) {
+                span[ds.key] = {ds.start, ds.end};
+            } else {
+                it->second.first = std::min(it->second.first, ds.start);
+                it->second.second = std::max(it->second.second, ds.end);
+            }
+        }
+        std::unordered_map<i64, std::pair<i64, i64>> base;  // key->off,base
+        for (auto& [key, mm] : span) {
+            KeyState& st = keys[key];
+            sort_key(st);
+            i64 base_key = mm.first, max_end = mm.second;
+            i64 n_panes = (max_end - base_key) / pane;
+            i64 off = (i64)st_vals.size();
+            base[key] = {off, base_key};
+            // pane partial sums via binary-searched edges
+            auto lo_it = st.ids.begin();
+            for (i64 p = 0; p < n_panes; ++p) {
+                i64 lo_key = base_key + p * pane;
+                i64 hi_key = lo_key + pane;
+                auto a = std::lower_bound(lo_it, st.ids.end(), lo_key);
+                auto b = std::lower_bound(a, st.ids.end(), hi_key);
+                double acc = 0.0;
+                for (auto v = a - st.ids.begin(), e = b - st.ids.begin();
+                     v < e; ++v)
+                    acc += st.vals[v];
+                st_vals.push_back(acc);
+                lo_it = b;
+            }
+        }
+        for (i64 d = 0; d < take; ++d) {
+            const Desc& ds = ready[d];
+            auto [off, base_key] = base[ds.key];
+            st_keys.push_back(ds.key);
+            st_gwids.push_back(ds.lwid);
+            st_starts.push_back(off + (ds.start - base_key) / pane);
+            st_ends.push_back(off + (ds.end - base_key) / pane);
+            st_rts.push_back(is_tb ? ds.lwid * slide + win - 1 : 0);
+        }
+        ready.erase(ready.begin(), ready.begin() + take);
+        // evict consumed prefixes
+        for (auto& [key, mm] : span) {
+            KeyState& st = keys[key];
+            i64 keep_from = st.next_fire * slide;
+            auto cut = std::lower_bound(st.ids.begin(), st.ids.end(),
+                                        keep_from) - st.ids.begin();
+            if (cut > 0) {
+                st.ids.erase(st.ids.begin(), st.ids.begin() + cut);
+                st.ts.erase(st.ts.begin(), st.ts.begin() + cut);
+                st.vals.erase(st.vals.begin(), st.vals.begin() + cut);
+            }
+        }
+        return take;
+    }
+
+    void eos() {
+        for (auto& [key, st] : keys) {
+            while (st.next_fire <= st.opened_max) {
+                ready.push_back(Desc{key, st.next_fire,
+                                     st.next_fire * slide,
+                                     st.next_fire * slide + win});
+                ++st.next_fire;
+            }
+        }
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* wfn_engine_new(i64 win, i64 slide, int is_tb, i64 delay) {
+    return new Engine(win, slide, is_tb != 0, delay);
+}
+
+void wfn_engine_free(void* e) { delete static_cast<Engine*>(e); }
+
+// Ingest a columnar batch (keys need not be grouped); returns the
+// number of ready (fired, unstaged) windows afterwards.
+i64 wfn_engine_ingest(void* ep, const i64* keys, const i64* ids,
+                      const i64* tss, const double* vals, i64 n) {
+    Engine& e = *static_cast<Engine*>(ep);
+    i64 i = 0;
+    while (i < n) {
+        i64 j = i + 1;
+        while (j < n && keys[j] == keys[i]) ++j;  // contiguous key run
+        e.ingest_key(keys[i], ids + i, tss + i, vals + i, j - i);
+        i = j;
+    }
+    return (i64)e.ready.size();
+}
+
+i64 wfn_engine_ready(void* ep) {
+    return (i64)static_cast<Engine*>(ep)->ready.size();
+}
+
+void wfn_engine_eos(void* ep) { static_cast<Engine*>(ep)->eos(); }
+
+// Stage up to max_windows; returns B staged.  Pointers are valid until
+// the next flush call.
+i64 wfn_engine_flush(void* ep, i64 max_windows, double** vals, i64* n_vals,
+                     i64** starts, i64** ends, i64** keys, i64** gwids,
+                     i64** rts) {
+    Engine& e = *static_cast<Engine*>(ep);
+    i64 b = e.flush(max_windows);
+    *vals = e.st_vals.data();
+    *n_vals = (i64)e.st_vals.size();
+    *starts = e.st_starts.data();
+    *ends = e.st_ends.data();
+    *keys = e.st_keys.data();
+    *gwids = e.st_gwids.data();
+    *rts = e.st_rts.data();
+    return b;
+}
+
+}  // extern "C"

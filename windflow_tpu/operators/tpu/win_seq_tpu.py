@@ -104,6 +104,24 @@ class WinSeqTPULogic(NodeLogic):
         # feeding the p99 metric of BASELINE.md
         self.latency_samples: List[float] = []
         self._batch_birth: Optional[float] = None
+        # the C++ columnar engine covers the hot standalone case
+        # (native/window_engine.cpp): builtin sum, SEQ role, identity
+        # window assignment, no renumbering, default value column
+        self._native = None
+        cfg = self.config
+        if (win_kind == "sum" and role == Role.SEQ and not renumbering
+                and cfg.n_outer == 1 and cfg.n_inner == 1
+                and cfg.id_outer == 0 and cfg.id_inner == 0
+                and value_of is None):
+            try:
+                from ...runtime.native import (NativeWindowEngine,
+                                               native_available)
+                if native_available():
+                    self._native = NativeWindowEngine(
+                        win_len, slide_len, win_type == WinType.TB,
+                        triggering_delay)
+            except Exception:
+                self._native = None
 
     # -- per-key helpers ---------------------------------------------------
     def _key_state(self, key) -> _TPUKeyState:
@@ -164,6 +182,21 @@ class WinSeqTPULogic(NodeLogic):
         import time as _time
         if len(self.latency_samples) < 100_000:
             self.latency_samples.append(_time.perf_counter() - birth)
+        if isinstance(descs, tuple) and descs[0] == "native":
+            # native-engine batch: columnar descriptor arrays
+            _, d_keys, d_gwids, d_rts = descs
+            if self.emit_batches:
+                emit(TupleBatch({"key": d_keys, "id": d_gwids,
+                                 "ts": d_rts,
+                                 "value": np.asarray(results, np.float64)}))
+            else:
+                for i in range(len(d_keys)):
+                    out = self.result_factory()
+                    out.value = float(results[i])
+                    out.set_control_fields(int(d_keys[i]), int(d_gwids[i]),
+                                           int(d_rts[i]))
+                    emit(out)
+            return
         if self.emit_batches and self.role == Role.SEQ:
             # columnar emission: one result TupleBatch per device batch
             out = TupleBatch({
@@ -323,7 +356,39 @@ class WinSeqTPULogic(NodeLogic):
     # -- columnar ingest (the zero-copy fast path: a whole TupleBatch is
     # partitioned by key and appended per key vectorized; the analogue of
     # the reference feeding batches straight from pinned staging) --------
+    def _native_launch(self, emit, max_windows=None):
+        """Stage ready windows from the C++ engine and launch one XLA
+        program over the pane-partial buffer."""
+        out = self._native.flush(max_windows or max(self.batch_len, 4096))
+        if out is None:
+            return
+        self._flush_pending(emit)  # waitAndFlush of the previous batch
+        vals, starts, ends, d_keys, d_gwids, d_rts = out
+        import time as _time
+        birth = self._batch_birth or _time.perf_counter()
+        self._batch_birth = None
+        handle = self.engine.compute({"value": vals}, starts, ends, d_gwids)
+        self.pending = (handle, ("native", d_keys, d_gwids, d_rts), birth)
+        self.launched_batches += 1
+        self._buffered_since_launch = 0
+
+    def _svc_batch_native(self, batch: TupleBatch, emit):
+        import time as _time
+        ids = batch.id if self.win_type == WinType.CB else batch.ts
+        ready = self._native.ingest(batch.key, ids, batch.ts,
+                                    batch["value"])
+        if ready and self._batch_birth is None:
+            self._batch_birth = _time.perf_counter()
+        self._buffered_since_launch += len(batch)
+        if ready >= self.batch_len or (
+                ready and self._buffered_since_launch
+                >= self.max_buffer_elems):
+            self._native_launch(emit)
+
     def _svc_batch(self, batch: TupleBatch, emit):
+        if self._native is not None:
+            self._svc_batch_native(batch, emit)
+            return
         keys = batch.key
         ids = batch.id if self.win_type == WinType.CB else batch.ts
         vals = batch["value"]
@@ -384,6 +449,19 @@ class WinSeqTPULogic(NodeLogic):
         if isinstance(item, TupleBatch):
             self._svc_batch(item, emit)
             return
+        if self._native is not None and not isinstance(item, EOSMarker):
+            # route records through the native engine as 1-row columns so
+            # mixed record/batch streams share one state store
+            key, tid, ts = item.get_control_fields()
+            self._svc_batch_native(TupleBatch({
+                "key": np.array([key], np.int64),
+                "id": np.array([tid], np.int64),
+                "ts": np.array([ts], np.int64),
+                "value": np.array([self.value_of(item)], np.float64),
+            }), emit)
+            return
+        if self._native is not None:
+            return  # EOS markers: the native engine fires on eos_flush
         is_marker = isinstance(item, EOSMarker)
         t = item.record if is_marker else item
         key, tid, ts = t.get_control_fields()
@@ -418,6 +496,12 @@ class WinSeqTPULogic(NodeLogic):
         """Fire every opened window, then drain both batches (the
         reference computes leftovers on CPU at EOS,
         win_seq_gpu.hpp:648-710; we just launch a final batch)."""
+        if self._native is not None:
+            self._native.eos()
+            while self._native.ready():
+                self._native_launch(emit)
+            self._flush_pending(emit)
+            return
         for key, st in self.keys.items():
             hashcode = default_hash(key)
             cfg = self.config

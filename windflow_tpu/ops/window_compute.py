@@ -30,9 +30,15 @@ falls out of JAX's asynchronous dispatch.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Any, Callable, Dict, Optional
 
 import numpy as np
+
+# One in-flight dispatch at a time: some PJRT transports (notably the
+# remote-relay backend used under test) are not robust to a thundering
+# herd of device_put calls from many host threads.
+_DISPATCH_LOCK = threading.Lock()
 
 BUILTIN_KINDS = ("sum", "count", "mean", "max", "min")
 
@@ -155,7 +161,8 @@ class DeviceBatchHandle:
         self._n = n_valid
 
     def block(self) -> np.ndarray:
-        return np.asarray(self._dev)[: self._n]
+        with _DISPATCH_LOCK:
+            return np.asarray(self._dev)[: self._n]
 
 
 class WindowComputeEngine:
@@ -182,6 +189,11 @@ class WindowComputeEngine:
     def compute(self, cols: Dict[str, np.ndarray], starts: np.ndarray,
                 ends: np.ndarray, gwids: np.ndarray) -> DeviceBatchHandle:
         """Launch one batch; returns an async handle."""
+        with _DISPATCH_LOCK:
+            return self._compute(cols, starts, ends, gwids)
+
+    def _compute(self, cols: Dict[str, np.ndarray], starts: np.ndarray,
+                 ends: np.ndarray, gwids: np.ndarray) -> DeviceBatchHandle:
         import jax.numpy as jnp
         B = len(starts)
         T = len(next(iter(cols.values())))

@@ -20,20 +20,22 @@ from typing import Any, Optional, Tuple
 
 _lib = None
 _lib_lock = threading.Lock()
-_SRC = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "native",
-    "windflow_native.cpp")
-_SO = os.path.join(os.path.dirname(_SRC), "libwindflow_native.so")
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SRCS = [os.path.join(_NATIVE_DIR, f)
+         for f in ("windflow_native.cpp", "window_engine.cpp")]
+_SO = os.path.join(_NATIVE_DIR, "libwindflow_native.so")
 
 
 def _build() -> Optional[str]:
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+    if os.path.exists(_SO) and all(
+            os.path.getmtime(_SO) >= os.path.getmtime(src) for src in _SRCS):
         return _SO
     try:
         subprocess.run(
             ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-             _SRC, "-o", _SO],
-            check=True, capture_output=True, timeout=120)
+             *_SRCS, "-o", _SO],
+            check=True, capture_output=True, timeout=180)
         return _SO
     except (OSError, subprocess.SubprocessError):
         return None
@@ -77,6 +79,23 @@ def get_lib():
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
             ctypes.c_longlong, ctypes.POINTER(ctypes.c_int),
             ctypes.POINTER(ctypes.c_longlong)]
+        LL = ctypes.c_longlong
+        PLL = ctypes.POINTER(LL)
+        PD = ctypes.POINTER(ctypes.c_double)
+        lib.wfn_engine_new.restype = ctypes.c_void_p
+        lib.wfn_engine_new.argtypes = [LL, LL, ctypes.c_int, LL]
+        lib.wfn_engine_free.argtypes = [ctypes.c_void_p]
+        lib.wfn_engine_ingest.restype = LL
+        lib.wfn_engine_ingest.argtypes = [ctypes.c_void_p, PLL, PLL, PLL,
+                                          PD, LL]
+        lib.wfn_engine_ready.restype = LL
+        lib.wfn_engine_ready.argtypes = [ctypes.c_void_p]
+        lib.wfn_engine_eos.argtypes = [ctypes.c_void_p]
+        lib.wfn_engine_flush.restype = LL
+        lib.wfn_engine_flush.argtypes = [
+            ctypes.c_void_p, LL, ctypes.POINTER(PD), PLL,
+            ctypes.POINTER(PLL), ctypes.POINTER(PLL), ctypes.POINTER(PLL),
+            ctypes.POINTER(PLL), ctypes.POINTER(PLL)]
         _lib = lib
         return lib
 
@@ -159,3 +178,68 @@ def pane_reduce(values, pos, kind: str):
     else:
         return None
     return out
+
+
+class NativeWindowEngine:
+    """ctypes wrapper over the C++ columnar window engine
+    (native/window_engine.cpp)."""
+
+    __slots__ = ("lib", "ptr")
+
+    def __init__(self, win_len: int, slide_len: int, is_tb: bool,
+                 delay: int = 0):
+        self.lib = get_lib()
+        if self.lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self.ptr = self.lib.wfn_engine_new(win_len, slide_len,
+                                           1 if is_tb else 0, delay)
+
+    def ingest(self, keys, ids, ts, vals) -> int:
+        import numpy as np
+        keys = np.ascontiguousarray(keys, np.int64)
+        ids = np.ascontiguousarray(ids, np.int64)
+        ts = np.ascontiguousarray(ts, np.int64)
+        vals = np.ascontiguousarray(vals, np.float64)
+        LL = ctypes.c_longlong
+        return self.lib.wfn_engine_ingest(
+            self.ptr,
+            keys.ctypes.data_as(ctypes.POINTER(LL)),
+            ids.ctypes.data_as(ctypes.POINTER(LL)),
+            ts.ctypes.data_as(ctypes.POINTER(LL)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            len(keys))
+
+    def ready(self) -> int:
+        return self.lib.wfn_engine_ready(self.ptr)
+
+    def eos(self) -> None:
+        self.lib.wfn_engine_eos(self.ptr)
+
+    def flush(self, max_windows: int):
+        """Returns (vals[f64], starts, ends, keys, gwids, rts) numpy
+        copies, or None when nothing is ready."""
+        import numpy as np
+        LL = ctypes.c_longlong
+        PD = ctypes.POINTER(ctypes.c_double)
+        PLL = ctypes.POINTER(LL)
+        vals_p, n_vals = PD(), LL()
+        sp, ep, kp, gp, rp = PLL(), PLL(), PLL(), PLL(), PLL()
+        b = self.lib.wfn_engine_flush(
+            self.ptr, max_windows, ctypes.byref(vals_p),
+            ctypes.byref(n_vals), ctypes.byref(sp), ctypes.byref(ep),
+            ctypes.byref(kp), ctypes.byref(gp), ctypes.byref(rp))
+        if b == 0:
+            return None
+        nv = n_vals.value
+
+        def arr(p, n, dt):
+            return np.ctypeslib.as_array(p, shape=(n,)).astype(dt, copy=True)
+
+        return (arr(vals_p, nv, np.float64), arr(sp, b, np.int64),
+                arr(ep, b, np.int64), arr(kp, b, np.int64),
+                arr(gp, b, np.int64), arr(rp, b, np.int64))
+
+    def __del__(self):
+        lib, ptr = getattr(self, "lib", None), getattr(self, "ptr", None)
+        if lib is not None and ptr:
+            lib.wfn_engine_free(ptr)
