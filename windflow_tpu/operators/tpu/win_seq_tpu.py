@@ -316,17 +316,17 @@ class WinSeqTPULogic(NodeLogic):
         self._batch_birth = None
         self.launched_batches += 1
         self._buffered_since_launch = 0
+        # the flat buffer snapshot is on device now: evict consumed prefixes
+        for k in keys_involved:
+            st = self.keys[k]
+            self._evict(st, wa.initial_id_of_key(default_hash(k), self.config,
+                                                 self.role))
 
     def _count_engine(self):
         # count over panes = sum of per-pane counts
         if not hasattr(self, "_count_eng"):
             self._count_eng = WindowComputeEngine("sum")
         return self._count_eng
-        # the flat buffer snapshot is on device now: evict consumed prefixes
-        for k in keys_involved:
-            st = self.keys[k]
-            self._evict(st, wa.initial_id_of_key(default_hash(k), self.config,
-                                                 self.role))
 
     # -- descriptor generation (window assignment) -------------------------
     def _fire_ready(self, key, st: _TPUKeyState, id_: int, hashcode: int,
