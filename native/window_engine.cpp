@@ -47,42 +47,177 @@ struct Engine {
     // staging buffers (valid until the next flush)
     std::vector<double> st_vals;
     std::vector<i64> st_starts, st_ends, st_keys, st_gwids, st_rts;
+    // scatter-ingest machinery: an open-addressing table maps key ->
+    // (KeyState*, per-call dense index).  Pass 1 does ONE table probe
+    // per tuple and counts per key; pass 2 writes each tuple straight
+    // into its key's arrays through a cursor.  Dense indices survive
+    // table growth (only slots move), so slot_of stays valid.
+    std::vector<i64> tab_key;
+    std::vector<KeyState*> tab_state;
+    std::vector<i64> tab_stamp;
+    std::vector<int32_t> tab_dense;
+    i64 call_id = 0;
+    // per-call dense arrays (index = order of first touch this call)
+    std::vector<KeyState*> d_state;
+    std::vector<i64> d_key, d_count, d_write, d_last, d_min, d_max;
+    std::vector<int32_t> slot_of;  // per-tuple dense index
+    static constexpr i64 EMPTY = INT64_MIN;
 
     Engine(i64 w, i64 s, bool tb, i64 d)
         : win(w), slide(s), delay(tb ? d : 0), is_tb(tb),
-          pane(std::gcd(w, s)) {}
+          pane(std::gcd(w, s)) {
+        tab_key.assign(1024, EMPTY);
+        tab_state.assign(1024, nullptr);
+        tab_stamp.assign(1024, -1);
+        tab_dense.assign(1024, 0);
+    }
 
-    void ingest_key(i64 key, const i64* ids, const i64* tss,
-                    const double* vals, i64 n) {
-        KeyState& st = keys[key];
-        i64 accept_from = st.next_fire > 0
-            ? (st.next_fire - 1) * slide + win : 0;
-        for (i64 j = 0; j < n; ++j) {
-            i64 id = ids[j];
-            if (id < accept_from) continue;  // behind the fired frontier
-            if (!st.ids.empty() && id < st.ids.back()) st.needs_sort = true;
-            st.ids.push_back(id);
-            st.ts.push_back(tss[j]);
-            st.vals.push_back(vals[j]);
-            if (id > st.max_id) st.max_id = id;
+    void grow_table() {
+        std::size_t m = tab_key.size() * 4;
+        std::vector<i64> nk(m, EMPTY);
+        std::vector<KeyState*> ns(m, nullptr);
+        std::vector<i64> nst(m, -1);
+        std::vector<int32_t> nd(m, 0);
+        for (std::size_t s = 0; s < tab_key.size(); ++s) {
+            if (tab_key[s] == EMPTY) continue;
+            std::size_t h = std::hash<i64>{}(tab_key[s]) & (m - 1);
+            while (nk[h] != EMPTY) h = (h + 1) & (m - 1);
+            nk[h] = tab_key[s];
+            ns[h] = tab_state[s];
+            nst[h] = tab_stamp[s];
+            nd[h] = tab_dense[s];
         }
-        if (st.max_id >= 0) {
-            i64 last_w;
-            if (win >= slide) {
-                last_w = (st.max_id + 1 + slide - 1) / slide - 1;
-            } else {
-                i64 nn = st.max_id / slide;
-                last_w = (st.max_id < nn * slide + win) ? nn : -1;
-            }
-            if (last_w > st.opened_max) st.opened_max = last_w;
-        }
+        tab_key.swap(nk);
+        tab_state.swap(ns);
+        tab_stamp.swap(nst);
+        tab_dense.swap(nd);
+    }
+
+    inline int32_t dense_of(i64 key) {
+        std::size_t mask = tab_key.size() - 1;
+        std::size_t h = std::hash<i64>{}(key) & mask;
         while (true) {
-            i64 end = st.next_fire * slide + win;
-            if (st.max_id < end + delay || st.next_fire > st.opened_max)
+            if (tab_key[h] == key) break;
+            if (tab_key[h] == EMPTY) {
+                if (keys.size() * 4 >= tab_key.size()) {
+                    grow_table();
+                    return dense_of(key);
+                }
+                tab_key[h] = key;
+                tab_state[h] = &keys[key];
+                tab_stamp[h] = -1;
                 break;
-            ready.push_back(Desc{key, st.next_fire,
-                                 st.next_fire * slide, end});
-            ++st.next_fire;
+            }
+            h = (h + 1) & mask;
+        }
+        if (tab_stamp[h] != call_id) {
+            tab_stamp[h] = call_id;
+            tab_dense[h] = (int32_t)d_key.size();
+            d_key.push_back(key);
+            d_state.push_back(tab_state[h]);
+            d_count.push_back(0);
+        }
+        return tab_dense[h];
+    }
+
+    void ingest_batch(const i64* bkeys, const i64* ids, const i64* tss,
+                      const double* vals, i64 n) {
+        ++call_id;
+        d_key.clear();
+        d_state.clear();
+        d_count.clear();
+        if ((i64)slot_of.size() < n) slot_of.resize(n);
+        for (i64 j = 0; j < n; ++j) {
+            int32_t d = dense_of(bkeys[j]);
+            ++d_count[d];
+            slot_of[j] = d;
+        }
+        std::size_t nd = d_key.size();
+        d_write.resize(nd);
+        d_last.resize(nd);
+        d_min.assign(nd, INT64_MAX);
+        d_max.assign(nd, INT64_MIN);
+        for (std::size_t d = 0; d < nd; ++d) {
+            KeyState& st = *d_state[d];
+            std::size_t base = st.ids.size();
+            st.ids.resize(base + d_count[d]);
+            if (!is_tb) st.ts.resize(base + d_count[d]);
+            st.vals.resize(base + d_count[d]);
+            d_write[d] = (i64)base;
+            d_last[d] = base ? st.ids[base - 1] : INT64_MIN;
+        }
+        if (is_tb) {
+            // TB: the sort key IS the timestamp; result timestamps come
+            // from window arithmetic, so the ts column is never stored
+            for (i64 j = 0; j < n; ++j) {
+                int32_t d = slot_of[j];
+                KeyState& st = *d_state[d];
+                i64 w = d_write[d]++;
+                i64 id = ids[j];
+                st.ids[w] = id;
+                st.vals[w] = vals[j];
+                if (id < d_last[d]) st.needs_sort = true;
+                d_last[d] = id;
+                if (id < d_min[d]) d_min[d] = id;
+                if (id > d_max[d]) d_max[d] = id;
+            }
+        } else {
+            for (i64 j = 0; j < n; ++j) {
+                int32_t d = slot_of[j];
+                KeyState& st = *d_state[d];
+                i64 w = d_write[d]++;
+                i64 id = ids[j];
+                st.ids[w] = id;
+                st.ts[w] = tss[j];
+                st.vals[w] = vals[j];
+                if (id < d_last[d]) st.needs_sort = true;
+                d_last[d] = id;
+                if (id < d_min[d]) d_min[d] = id;
+                if (id > d_max[d]) d_max[d] = id;
+            }
+        }
+        for (std::size_t d = 0; d < nd; ++d) {
+            KeyState& st = *d_state[d];
+            i64 accept_from = st.next_fire > 0
+                ? (st.next_fire - 1) * slide + win : 0;
+            if (d_min[d] < accept_from) {
+                // late tuples behind the fired frontier: compact them
+                // out of the just-appended block (arrival order kept,
+                // matching the per-tuple skip of the scalar path)
+                i64 base = d_write[d] - d_count[d];
+                i64 w = base;
+                for (i64 r = base; r < d_write[d]; ++r) {
+                    if (st.ids[r] >= accept_from) {
+                        st.ids[w] = st.ids[r];
+                        if (!is_tb) st.ts[w] = st.ts[r];
+                        st.vals[w] = st.vals[r];
+                        ++w;
+                    }
+                }
+                st.ids.resize(w);
+                if (!is_tb) st.ts.resize(w);
+                st.vals.resize(w);
+            }
+            if (d_max[d] > st.max_id) st.max_id = d_max[d];
+            if (st.max_id >= 0) {
+                i64 last_w;
+                if (win >= slide) {
+                    last_w = (st.max_id + 1 + slide - 1) / slide - 1;
+                } else {
+                    i64 nn = st.max_id / slide;
+                    last_w = (st.max_id < nn * slide + win) ? nn : -1;
+                }
+                if (last_w > st.opened_max) st.opened_max = last_w;
+            }
+            i64 key = d_key[d];
+            while (true) {
+                i64 end = st.next_fire * slide + win;
+                if (st.max_id < end + delay || st.next_fire > st.opened_max)
+                    break;
+                ready.push_back(Desc{key, st.next_fire,
+                                     st.next_fire * slide, end});
+                ++st.next_fire;
+            }
         }
     }
 
@@ -93,16 +228,20 @@ struct Engine {
         std::stable_sort(idx.begin(), idx.end(), [&](auto a, auto b) {
             return st.ids[a] < st.ids[b];
         });
-        std::vector<i64> ids2(st.ids.size()), ts2(st.ids.size());
+        std::vector<i64> ids2(st.ids.size());
         std::vector<double> v2(st.ids.size());
         for (std::size_t j = 0; j < idx.size(); ++j) {
             ids2[j] = st.ids[idx[j]];
-            ts2[j] = st.ts[idx[j]];
             v2[j] = st.vals[idx[j]];
         }
         st.ids.swap(ids2);
-        st.ts.swap(ts2);
         st.vals.swap(v2);
+        if (!st.ts.empty()) {
+            std::vector<i64> ts2(st.ids.size());
+            for (std::size_t j = 0; j < idx.size(); ++j)
+                ts2[j] = st.ts[idx[j]];
+            st.ts.swap(ts2);
+        }
         st.needs_sort = false;
     }
 
@@ -160,18 +299,41 @@ struct Engine {
             st_gwids.push_back(ds.lwid);
             st_starts.push_back(off + (ds.start - base_key) / pane);
             st_ends.push_back(off + (ds.end - base_key) / pane);
-            st_rts.push_back(is_tb ? ds.lwid * slide + win - 1 : 0);
+            if (is_tb) {
+                st_rts.push_back(ds.lwid * slide + win - 1);
+            } else {
+                // CB: result timestamp = ts of the last tuple in the
+                // window extent (matches the host engine / reference)
+                KeyState& st = keys[ds.key];
+                auto lo = std::lower_bound(st.ids.begin(), st.ids.end(),
+                                           ds.start);
+                auto hi = std::lower_bound(lo, st.ids.end(), ds.end);
+                st_rts.push_back(hi > lo
+                    ? st.ts[(hi - st.ids.begin()) - 1] : 0);
+            }
         }
         ready.erase(ready.begin(), ready.begin() + take);
-        // evict consumed prefixes
+        // evict consumed prefixes -- but never past the earliest window
+        // still queued in `ready` for the key (a partial take leaves
+        // fired-but-unstaged windows whose extents must stay resident)
+        std::unordered_map<i64, i64> queued_floor;
+        for (const Desc& ds : ready) {
+            auto it = queued_floor.find(ds.key);
+            if (it == queued_floor.end() || ds.start < it->second)
+                queued_floor[ds.key] = ds.start;
+        }
         for (auto& [key, mm] : span) {
             KeyState& st = keys[key];
             i64 keep_from = st.next_fire * slide;
+            auto qf = queued_floor.find(key);
+            if (qf != queued_floor.end() && qf->second < keep_from)
+                keep_from = qf->second;
             auto cut = std::lower_bound(st.ids.begin(), st.ids.end(),
                                         keep_from) - st.ids.begin();
             if (cut > 0) {
                 st.ids.erase(st.ids.begin(), st.ids.begin() + cut);
-                st.ts.erase(st.ts.begin(), st.ts.begin() + cut);
+                if (!is_tb)
+                    st.ts.erase(st.ts.begin(), st.ts.begin() + cut);
                 st.vals.erase(st.vals.begin(), st.vals.begin() + cut);
             }
         }
@@ -205,13 +367,7 @@ void wfn_engine_free(void* e) { delete static_cast<Engine*>(e); }
 i64 wfn_engine_ingest(void* ep, const i64* keys, const i64* ids,
                       const i64* tss, const double* vals, i64 n) {
     Engine& e = *static_cast<Engine*>(ep);
-    i64 i = 0;
-    while (i < n) {
-        i64 j = i + 1;
-        while (j < n && keys[j] == keys[i]) ++j;  // contiguous key run
-        e.ingest_key(keys[i], ids + i, tss + i, vals + i, j - i);
-        i = j;
-    }
+    e.ingest_batch(keys, ids, tss, vals, n);
     return (i64)e.ready.size();
 }
 

@@ -129,3 +129,26 @@ def test_full_graph_over_native_channels():
         .add_sink(wf.SinkBuilder(snk).build())
     g.run()
     assert total["v"] == sum(range(200))
+
+
+def test_engine_partial_flush_keeps_queued_window_data():
+    """A flush smaller than the ready count must not evict tuples still
+    needed by fired-but-unstaged windows (window_engine.cpp eviction)."""
+    import numpy as np
+    from windflow_tpu.runtime.native import NativeWindowEngine
+
+    eng = NativeWindowEngine(4, 2, True, 0)
+    n = 100
+    eng.ingest(np.zeros(n, np.int64), np.arange(n), np.arange(n),
+               np.ones(n))
+    assert eng.ready() == 48
+    seen = 0
+    while True:
+        out = eng.flush(10)
+        if out is None:
+            break
+        vals, starts, ends, _keys, gwids, _rts = out
+        for i in range(len(gwids)):
+            assert vals[starts[i]:ends[i]].sum() == 4.0, int(gwids[i])
+            seen += 1
+    assert seen == 48

@@ -70,7 +70,7 @@ class WinSeqTPULogic(NodeLogic):
                  replica_index: int = 0, renumbering: bool = False,
                  value_of: Callable[[Any], float] = None,
                  closing_func: Callable = None, emit_batches: bool = False,
-                 max_buffer_elems: int = 1 << 19):
+                 max_buffer_elems: int = 1 << 19, inflight_depth: int = 4):
         if win_len == 0 or slide_len == 0:
             raise ValueError("win_len and slide_len must be > 0")
         self.engine = WindowComputeEngine(win_kind)
@@ -90,8 +90,14 @@ class WinSeqTPULogic(NodeLogic):
         self.keys: Dict[Any, _TPUKeyState] = {}
         # batch under assembly: descriptors (key, gwid, start_key, end_key)
         self.descriptors: List = []
-        # in-flight batch: (handle, descriptor list)
-        self.pending: Optional[tuple] = None
+        # in-flight batches, oldest first: (handle, descriptors, birth).
+        # Depth > 1 keeps several device programs + async D2H copies in
+        # flight so one high-latency transport roundtrip amortizes over
+        # many launches (deepens the reference's 2-deep waitAndFlush
+        # pipeline, win_seq_gpu.hpp:267-297).
+        from collections import deque
+        self.pending = deque()
+        self.inflight_depth = max(1, inflight_depth)
         self.ignored_tuples = 0
         self.launched_batches = 0
         # launch also when this much unshipped data is buffered, even if
@@ -173,15 +179,19 @@ class WinSeqTPULogic(NodeLogic):
             st.values = st.values[cut:]
 
     # -- batch plane -------------------------------------------------------
-    def _flush_pending(self, emit) -> None:
-        if self.pending is None:
-            return
-        handle, descs, birth = self.pending
-        self.pending = None
-        results = handle.block()
-        import time as _time
-        if len(self.latency_samples) < 100_000:
-            self.latency_samples.append(_time.perf_counter() - birth)
+    def _flush_pending(self, emit, drain: bool = False) -> None:
+        """Emit completed in-flight batches: the oldest when the
+        pipeline is at depth (waitAndFlush), or all when draining."""
+        while self.pending and (drain
+                                or len(self.pending) >= self.inflight_depth):
+            handle, descs, birth = self.pending.popleft()
+            results = handle.block()
+            import time as _time
+            if len(self.latency_samples) < 100_000:
+                self.latency_samples.append(_time.perf_counter() - birth)
+            self._emit_results(results, descs, emit)
+
+    def _emit_results(self, results, descs, emit) -> None:
         if isinstance(descs, tuple) and descs[0] == "native":
             # native-engine batch: columnar descriptor arrays
             _, d_keys, d_gwids, d_rts = descs
@@ -311,8 +321,8 @@ class WinSeqTPULogic(NodeLogic):
             eng = self._count_engine()
         handle = eng.compute({"value": flat_vals}, starts, ends, gwids)
         import time as _time
-        self.pending = (handle, descs,
-                        self._batch_birth or _time.perf_counter())
+        self.pending.append((handle, descs,
+                             self._batch_birth or _time.perf_counter()))
         self._batch_birth = None
         self.launched_batches += 1
         self._buffered_since_launch = 0
@@ -368,7 +378,8 @@ class WinSeqTPULogic(NodeLogic):
         birth = self._batch_birth or _time.perf_counter()
         self._batch_birth = None
         handle = self.engine.compute({"value": vals}, starts, ends, d_gwids)
-        self.pending = (handle, ("native", d_keys, d_gwids, d_rts), birth)
+        self.pending.append((handle, ("native", d_keys, d_gwids, d_rts),
+                             birth))
         self.launched_batches += 1
         self._buffered_since_launch = 0
 
@@ -500,7 +511,7 @@ class WinSeqTPULogic(NodeLogic):
             self._native.eos()
             while self._native.ready():
                 self._native_launch(emit)
-            self._flush_pending(emit)
+            self._flush_pending(emit, drain=True)
             return
         for key, st in self.keys.items():
             hashcode = default_hash(key)
@@ -518,7 +529,7 @@ class WinSeqTPULogic(NodeLogic):
                 if len(self.descriptors) >= self.batch_len:
                     self._launch(emit)
         self._launch(emit)
-        self._flush_pending(emit)
+        self._flush_pending(emit, drain=True)
 
     def svc_end(self):
         if self.closing_func is not None:
@@ -533,7 +544,8 @@ class WinSeqTPU(Operator):
     def __init__(self, win_kind, win_len, slide_len, win_type,
                  batch_len=DEFAULT_BATCH_LEN, triggering_delay=0,
                  name="win_seq_tpu", result_factory=BasicRecord,
-                 value_of=None, closing_func=None, emit_batches=False):
+                 value_of=None, closing_func=None, emit_batches=False,
+                 max_buffer_elems=1 << 19, inflight_depth=4):
         super().__init__(name, 1, RoutingMode.FORWARD, Pattern.WIN_SEQ_TPU)
         self.win_type = win_type
         self.kwargs = dict(
@@ -541,7 +553,8 @@ class WinSeqTPU(Operator):
             win_type=win_type, batch_len=batch_len,
             triggering_delay=triggering_delay, result_factory=result_factory,
             value_of=value_of, closing_func=closing_func,
-            emit_batches=emit_batches)
+            emit_batches=emit_batches, max_buffer_elems=max_buffer_elems,
+            inflight_depth=inflight_depth)
         self._renumbering = False
 
     def enable_renumbering(self):

@@ -152,13 +152,23 @@ def _ffat_program(combine: Callable, neutral: float, t_pad: int):
 
 class DeviceBatchHandle:
     """Async result of one batched window computation (the PJRT-future
-    analogue of the reference's in-flight CUDA kernel)."""
+    analogue of the reference's in-flight CUDA kernel).
+
+    The device-to-host copy is started asynchronously at construction
+    (``copy_to_host_async``): over a high-latency PJRT transport the
+    transfer rides under subsequent host batching, so ``block()`` is
+    near-free by the time the double-buffer protocol flushes this
+    batch -- the cudaMemcpyAsync-D2H analogue (win_seq_gpu.hpp:610)."""
 
     __slots__ = ("_dev", "_n")
 
     def __init__(self, dev_array, n_valid: int):
         self._dev = dev_array
         self._n = n_valid
+        try:
+            dev_array.copy_to_host_async()
+        except Exception:
+            pass  # backends without async host copy: block() still works
 
     def block(self) -> np.ndarray:
         with _DISPATCH_LOCK:

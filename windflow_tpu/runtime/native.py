@@ -27,15 +27,26 @@ _SRCS = [os.path.join(_NATIVE_DIR, f)
 _SO = os.path.join(_NATIVE_DIR, "libwindflow_native.so")
 
 
+_CMD = ["g++", "-O3", "-march=native", "-std=c++17", "-shared",
+        "-fPIC", "-pthread", *_SRCS, "-o", _SO]
+_STAMP = _SO + ".cmd"
+
+
 def _build() -> Optional[str]:
-    if os.path.exists(_SO) and all(
-            os.path.getmtime(_SO) >= os.path.getmtime(src) for src in _SRCS):
+    cmd_str = " ".join(_CMD)
+    fresh = os.path.exists(_SO) and all(
+        os.path.getmtime(_SO) >= os.path.getmtime(src) for src in _SRCS)
+    try:
+        with open(_STAMP) as f:
+            same_cmd = f.read() == cmd_str
+    except OSError:
+        same_cmd = False
+    if fresh and same_cmd:
         return _SO
     try:
-        subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-             *_SRCS, "-o", _SO],
-            check=True, capture_output=True, timeout=180)
+        subprocess.run(_CMD, check=True, capture_output=True, timeout=180)
+        with open(_STAMP, "w") as f:
+            f.write(cmd_str)
         return _SO
     except (OSError, subprocess.SubprocessError):
         return None
