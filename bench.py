@@ -43,7 +43,7 @@ SLIDE = 2048
 SOURCE_BATCH = 524_288
 DEVICE_BATCH = 16_384
 MAX_BUFFER = 1 << 19
-INFLIGHT = 4
+INFLIGHT = 8
 HOST_BASELINE_EVENTS = 400_000
 
 

@@ -79,9 +79,11 @@ struct Engine {
         std::vector<i64> nst(m, -1);
         std::vector<int32_t> nd(m, 0);
         for (std::size_t s = 0; s < tab_key.size(); ++s) {
-            if (tab_key[s] == EMPTY) continue;
+            // occupancy = non-null state pointer, NOT the key sentinel:
+            // a real key may equal INT64_MIN
+            if (tab_state[s] == nullptr) continue;
             std::size_t h = std::hash<i64>{}(tab_key[s]) & (m - 1);
-            while (nk[h] != EMPTY) h = (h + 1) & (m - 1);
+            while (ns[h] != nullptr) h = (h + 1) & (m - 1);
             nk[h] = tab_key[s];
             ns[h] = tab_state[s];
             nst[h] = tab_stamp[s];
@@ -97,8 +99,8 @@ struct Engine {
         std::size_t mask = tab_key.size() - 1;
         std::size_t h = std::hash<i64>{}(key) & mask;
         while (true) {
-            if (tab_key[h] == key) break;
-            if (tab_key[h] == EMPTY) {
+            if (tab_state[h] != nullptr && tab_key[h] == key) break;
+            if (tab_state[h] == nullptr) {
                 if (keys.size() * 4 >= tab_key.size()) {
                     grow_table();
                     return dense_of(key);

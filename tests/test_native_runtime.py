@@ -131,6 +131,31 @@ def test_full_graph_over_native_channels():
     assert total["v"] == sum(range(200))
 
 
+def test_engine_int64_min_key():
+    """INT64_MIN is a valid tuple key: it must not collide with the
+    hash table's empty-slot sentinel (window_engine.cpp dense_of)."""
+    import numpy as np
+    from windflow_tpu.runtime.native import NativeWindowEngine
+
+    eng = NativeWindowEngine(8, 4, False, 0)
+    kmin = np.iinfo(np.int64).min
+    keys = np.array([kmin, 5] * 40, np.int64)
+    ids = np.arange(80, dtype=np.int64) // 2
+    eng.ingest(keys, ids, ids, np.ones(80))
+    eng.eos()
+    got = {}
+    while True:
+        out = eng.flush(1000)
+        if out is None:
+            break
+        vals, starts, ends, d_keys, gwids, _rts = out
+        for i in range(len(d_keys)):
+            got.setdefault(int(d_keys[i]), []).append(
+                vals[starts[i]:ends[i]].sum())
+    assert set(got) == {kmin, 5}
+    assert got[kmin][0] == 8.0 and got[5][0] == 8.0
+
+
 def test_engine_partial_flush_keeps_queued_window_data():
     """A flush smaller than the ready count must not evict tuples still
     needed by fired-but-unstaged windows (window_engine.cpp eviction)."""

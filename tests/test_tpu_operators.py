@@ -165,6 +165,29 @@ def test_win_seq_tpu_builtin_kinds(kind, agg):
     assert coll.by_key() == {k: expect for k in range(3)}
 
 
+@pytest.mark.parametrize("native_panes", [True, False])
+@pytest.mark.parametrize("kind,agg", [("max", max), ("min", min),
+                                      ("sum", sum)])
+def test_win_seq_tpu_pane_path_with_retained_tail(kind, agg, native_panes,
+                                                  monkeypatch):
+    """Pane pre-reduction (pane = gcd >= 16) with launches that happen
+    while later tuples are already retained: the last pane of a batch
+    must not absorb tuples beyond its window edge (reduceat's final
+    segment runs to the end of the array).  Covers both the native
+    pane_reduce helper and the numpy fallback."""
+    if not native_panes:
+        from windflow_tpu.runtime import native as native_mod
+        monkeypatch.setattr(native_mod, "pane_reduce",
+                            lambda *a, **k: None)
+    b = wf.WinSeqTPUBuilder(kind).with_batch(2).with_tb_windows(64, 32)
+    coll = run_graph(b.build(), n_keys=2, per_key=400)
+    expect = oracle(400, 64, 32, agg=agg)
+    got = coll.by_key()
+    assert set(got) == {0, 1}
+    for k in got:
+        assert got[k] == pytest.approx(expect, rel=1e-5)
+
+
 @pytest.mark.parametrize("par", [1, 3])
 @pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
 def test_key_farm_tpu(par, win_type):

@@ -38,6 +38,111 @@ from ..base import Operator, StageSpec
 DEFAULT_BATCH_LEN = 256
 
 
+class _AsyncDispatcher:
+    """Dedicated launch thread: the ingest thread stages numpy buffers
+    and hands them off; this thread pays the host->device transfer
+    latency, keeps ``inflight_depth`` programs in flight, and emits
+    completed results.  The reference overlaps CUDA streams with host
+    batching on ONE thread (win_seq_gpu.hpp:267-297); over a
+    high-latency PJRT transport the dispatch itself blocks for a round
+    trip, so it must come off the ingest thread entirely."""
+
+    __slots__ = ("logic", "work", "thread", "error", "aborting")
+
+    def __init__(self, logic: "WinSeqTPULogic"):
+        import queue as _q
+        import threading as _t
+        self.logic = logic
+        self.work = _q.Queue(maxsize=max(1, logic.inflight_depth))
+        self.error: Optional[BaseException] = None
+        self.aborting = False
+        self.thread = _t.Thread(target=self._run, daemon=True,
+                                name="winseq-tpu-dispatch")
+        self.thread.start()
+
+    def submit(self, item) -> None:
+        import queue as _q
+        # bounded put re-checking for a dead/failed dispatcher: a plain
+        # blocking put could hang forever if the thread errors out while
+        # the queue is full (nothing would ever drain it)
+        while True:
+            if self.error is not None:
+                raise RuntimeError("window dispatch thread failed") \
+                    from self.error
+            try:
+                self.work.put(item, timeout=0.25)
+                return
+            except _q.Full:
+                continue
+
+    def drain(self) -> None:
+        """EOS barrier: launch everything staged, flush every handle."""
+        import queue as _q
+        while True:  # the consumer drains even after an error, so the
+            try:     # sentinel always fits eventually
+                self.work.put(None, timeout=0.25)
+                break
+            except _q.Full:
+                continue
+        self.thread.join()
+        if self.error is not None:
+            raise RuntimeError("window dispatch thread failed") \
+                from self.error
+
+    def abort(self) -> None:
+        """Node-error teardown: drop the backlog without launching it
+        (no EOS barrier -- the downstream channel is closing)."""
+        import queue as _q
+        self.aborting = True
+        try:
+            self.work.put_nowait(None)
+        except _q.Full:
+            pass  # the run loop polls `aborting` on empty reads
+        self.thread.join(timeout=30)
+
+    def _run(self) -> None:
+        from collections import deque
+        import queue as _q
+        logic = self.logic
+        pending = deque()
+        last_emit = None
+        while True:
+            try:
+                item = self.work.get(timeout=0.25)
+            except _q.Empty:
+                if self.aborting:
+                    return
+                # idle stream: drain whatever already completed so a
+                # stalled-but-unterminated source doesn't withhold
+                # results until the pipeline refills to depth
+                while (pending and self.error is None
+                       and pending[0][0].ready()):
+                    try:
+                        logic._finish(pending.popleft(), last_emit)
+                    except BaseException as e:
+                        self.error = e
+                continue
+            if item is None:
+                break
+            if self.aborting or self.error is not None:
+                continue  # failed/aborted: drain the queue, launch nothing
+            engine, cols, starts, ends, gwids, descs, birth, emit = item
+            last_emit = emit
+            try:
+                handle = engine.compute(cols, starts, ends, gwids)
+                logic.launched_batches += 1
+                pending.append((handle, descs, birth))
+                while len(pending) >= logic.inflight_depth:
+                    logic._finish(pending.popleft(), emit)
+            except BaseException as e:  # surfaced on next submit / drain
+                self.error = e
+        while pending and self.error is None and not self.aborting:
+            try:
+                logic._finish(pending.popleft(), last_emit)
+            except BaseException as e:
+                self.error = e
+
+
 class _TPUKeyState:
     __slots__ = ("sort_keys", "ts", "values", "pending_sort", "pending_ts",
                  "pending_val", "pending_chunks", "next_fire", "opened_max",
@@ -70,7 +175,8 @@ class WinSeqTPULogic(NodeLogic):
                  replica_index: int = 0, renumbering: bool = False,
                  value_of: Callable[[Any], float] = None,
                  closing_func: Callable = None, emit_batches: bool = False,
-                 max_buffer_elems: int = 1 << 19, inflight_depth: int = 4):
+                 max_buffer_elems: int = 1 << 19, inflight_depth: int = 4,
+                 async_dispatch: bool = True):
         if win_len == 0 or slide_len == 0:
             raise ValueError("win_len and slide_len must be > 0")
         self.engine = WindowComputeEngine(win_kind)
@@ -98,6 +204,8 @@ class WinSeqTPULogic(NodeLogic):
         from collections import deque
         self.pending = deque()
         self.inflight_depth = max(1, inflight_depth)
+        self.async_dispatch = async_dispatch
+        self._dispatcher: Optional[_AsyncDispatcher] = None
         self.ignored_tuples = 0
         self.launched_batches = 0
         # launch also when this much unshipped data is buffered, even if
@@ -179,17 +287,46 @@ class WinSeqTPULogic(NodeLogic):
             st.values = st.values[cut:]
 
     # -- batch plane -------------------------------------------------------
+    def _finish(self, entry, emit) -> None:
+        """Flush one in-flight batch: block on its handle, sample the
+        window-result latency, emit."""
+        handle, descs, birth = entry
+        results = handle.block()
+        import time as _time
+        if len(self.latency_samples) < 100_000:
+            self.latency_samples.append(_time.perf_counter() - birth)
+        self._emit_results(results, descs, emit)
+
+    def _submit(self, cols, starts, ends, gwids, descs, birth, emit,
+                engine=None) -> None:
+        """Hand one staged batch to the device: via the dispatcher
+        thread (default) or inline with the waitAndFlush protocol."""
+        eng = engine or self.engine
+        if self.async_dispatch:
+            if self._dispatcher is None:
+                self._dispatcher = _AsyncDispatcher(self)
+            self._dispatcher.submit(
+                (eng, cols, starts, ends, gwids, descs, birth, emit))
+        else:
+            self._flush_pending(emit)  # waitAndFlush of the previous
+            handle = eng.compute(cols, starts, ends, gwids)
+            self.launched_batches += 1
+            self.pending.append((handle, descs, birth))
+        self._buffered_since_launch = 0
+
     def _flush_pending(self, emit, drain: bool = False) -> None:
         """Emit completed in-flight batches: the oldest when the
-        pipeline is at depth (waitAndFlush), or all when draining."""
+        pipeline is at depth (waitAndFlush), or all when draining
+        (inline-dispatch mode only)."""
         while self.pending and (drain
                                 or len(self.pending) >= self.inflight_depth):
-            handle, descs, birth = self.pending.popleft()
-            results = handle.block()
-            import time as _time
-            if len(self.latency_samples) < 100_000:
-                self.latency_samples.append(_time.perf_counter() - birth)
-            self._emit_results(results, descs, emit)
+            self._finish(self.pending.popleft(), emit)
+
+    def _drain_all(self, emit) -> None:
+        if self._dispatcher is not None:
+            self._dispatcher.drain()
+            self._dispatcher = None
+        self._flush_pending(emit, drain=True)
 
     def _emit_results(self, results, descs, emit) -> None:
         if isinstance(descs, tuple) and descs[0] == "native":
@@ -247,21 +384,30 @@ class WinSeqTPULogic(NodeLogic):
         pos = np.searchsorted(st.sort_keys, edges)
         if kind == "count":
             return np.diff(pos).astype(np.float64)
+        from ...runtime.native import pane_reduce
+        red = pane_reduce(st.values, pos, kind)  # exact [pos[i], pos[i+1])
+        if red is not None:
+            return red
         if kind == "sum":
             cs = np.concatenate([[0.0], np.cumsum(st.values)])
             return cs[pos[1:]] - cs[pos[:-1]]
         neutral = -np.inf if kind == "max" else np.inf
         ufunc = np.maximum if kind == "max" else np.minimum
-        safe = np.minimum(pos[:-1], max(len(st.values) - 1, 0))
-        if len(st.values) == 0:
-            return np.full(n_panes, neutral)
-        red = ufunc.reduceat(st.values, safe)
-        return np.where(np.diff(pos) > 0, red, neutral)
+        # reduceat over the non-empty panes' start edges only: empty
+        # panes collapse to equal edges so each segment ends exactly at
+        # the next non-empty pane's start, and clipping the buffer at
+        # pos[-1] keeps retained tuples beyond the batch's last window
+        # edge out of the final segment (reduceat runs it to the end)
+        vals = st.values[:int(pos[-1])]
+        out = np.full(n_panes, neutral)
+        nonempty = np.nonzero(np.diff(pos) > 0)[0]
+        if len(nonempty):
+            out[nonempty] = ufunc.reduceat(vals, pos[nonempty])
+        return out
 
     def _launch(self, emit) -> None:
         if not self.descriptors:
             return
-        self._flush_pending(emit)  # waitAndFlush of the previous kernel
         descs = self.descriptors
         self.descriptors = []
         # group descriptors per key (preserving order)
@@ -319,14 +465,13 @@ class WinSeqTPULogic(NodeLogic):
         eng = self.engine
         if use_panes and kind == "count":
             eng = self._count_engine()
-        handle = eng.compute({"value": flat_vals}, starts, ends, gwids)
         import time as _time
-        self.pending.append((handle, descs,
-                             self._batch_birth or _time.perf_counter()))
+        birth = self._batch_birth or _time.perf_counter()
         self._batch_birth = None
-        self.launched_batches += 1
-        self._buffered_since_launch = 0
-        # the flat buffer snapshot is on device now: evict consumed prefixes
+        self._submit({"value": flat_vals}, starts, ends, gwids, descs,
+                     birth, emit, engine=eng)
+        # the staged flat buffer is dispatcher-owned now: evict consumed
+        # prefixes
         for k in keys_involved:
             st = self.keys[k]
             self._evict(st, wa.initial_id_of_key(default_hash(k), self.config,
@@ -372,16 +517,12 @@ class WinSeqTPULogic(NodeLogic):
         out = self._native.flush(max_windows or max(self.batch_len, 4096))
         if out is None:
             return
-        self._flush_pending(emit)  # waitAndFlush of the previous batch
         vals, starts, ends, d_keys, d_gwids, d_rts = out
         import time as _time
         birth = self._batch_birth or _time.perf_counter()
         self._batch_birth = None
-        handle = self.engine.compute({"value": vals}, starts, ends, d_gwids)
-        self.pending.append((handle, ("native", d_keys, d_gwids, d_rts),
-                             birth))
-        self.launched_batches += 1
-        self._buffered_since_launch = 0
+        self._submit({"value": vals}, starts, ends, d_gwids,
+                     ("native", d_keys, d_gwids, d_rts), birth, emit)
 
     def _svc_batch_native(self, batch: TupleBatch, emit):
         import time as _time
@@ -511,7 +652,7 @@ class WinSeqTPULogic(NodeLogic):
             self._native.eos()
             while self._native.ready():
                 self._native_launch(emit)
-            self._flush_pending(emit, drain=True)
+            self._drain_all(emit)
             return
         for key, st in self.keys.items():
             hashcode = default_hash(key)
@@ -529,9 +670,15 @@ class WinSeqTPULogic(NodeLogic):
                 if len(self.descriptors) >= self.batch_len:
                     self._launch(emit)
         self._launch(emit)
-        self._flush_pending(emit, drain=True)
+        self._drain_all(emit)
 
     def svc_end(self):
+        # error-path teardown: eos_flush already drained (and cleared)
+        # the dispatcher on the normal path, so one still present here
+        # means the node thread aborted -- stop launching its backlog
+        if self._dispatcher is not None:
+            self._dispatcher.abort()
+            self._dispatcher = None
         if self.closing_func is not None:
             from ...core.context import RuntimeContext
             self.closing_func(RuntimeContext())
@@ -545,7 +692,8 @@ class WinSeqTPU(Operator):
                  batch_len=DEFAULT_BATCH_LEN, triggering_delay=0,
                  name="win_seq_tpu", result_factory=BasicRecord,
                  value_of=None, closing_func=None, emit_batches=False,
-                 max_buffer_elems=1 << 19, inflight_depth=4):
+                 max_buffer_elems=1 << 19, inflight_depth=4,
+                 async_dispatch=True):
         super().__init__(name, 1, RoutingMode.FORWARD, Pattern.WIN_SEQ_TPU)
         self.win_type = win_type
         self.kwargs = dict(
@@ -554,7 +702,7 @@ class WinSeqTPU(Operator):
             triggering_delay=triggering_delay, result_factory=result_factory,
             value_of=value_of, closing_func=closing_func,
             emit_batches=emit_batches, max_buffer_elems=max_buffer_elems,
-            inflight_depth=inflight_depth)
+            inflight_depth=inflight_depth, async_dispatch=async_dispatch)
         self._renumbering = False
 
     def enable_renumbering(self):

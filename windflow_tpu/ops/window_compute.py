@@ -63,10 +63,14 @@ def _jax():
 
 @functools.lru_cache(maxsize=None)
 def _scan_program(kind: str):
+    """``se`` packs [starts; ends] as one int32 [2, B] array: a single
+    host->device transfer instead of three (padding rows are (0, 0), so
+    their range sums are 0 and the host slice drops them anyway)."""
     jax, jnp = _jax()
 
     @jax.jit
-    def run(values, starts, ends, valid):
+    def run(values, se):
+        starts, ends = se[0], se[1]
         c = jnp.concatenate([jnp.zeros((1,), values.dtype),
                              jnp.cumsum(values)])
         s = c[ends] - c[starts]
@@ -77,7 +81,7 @@ def _scan_program(kind: str):
             out = n
         else:  # mean
             out = s / jnp.maximum(n, 1)
-        return jnp.where(valid, out, 0)
+        return out
 
     return run
 
@@ -92,7 +96,8 @@ def _sparse_table_program(kind: str, n_levels: int):
     comb = jnp.maximum if kind == "max" else jnp.minimum
 
     @jax.jit
-    def run(values, starts, ends, valid):
+    def run(values, se):
+        starts, ends = se[0], se[1]
         T = values.shape[0]
         levels = [values]
         v = values
@@ -109,7 +114,9 @@ def _sparse_table_program(kind: str, n_levels: int):
         hi = jnp.clip(ends - (1 << j), 0, T - 1)
         lo = jnp.clip(starts, 0, T - 1)
         out = comb(table[j, lo], table[j, hi])
-        return jnp.where(valid, out, 0)
+        # padding rows ((0,0) extents) may hold +-inf; zero them so the
+        # host-side result buffer stays finite
+        return jnp.where(se[1] > se[0], out, 0)
 
     return run
 
@@ -142,7 +149,9 @@ def _ffat_program(combine: Callable, neutral: float, t_pad: int):
     build, _update, query = _programs(combine, neutral, t_pad)
 
     @jax.jit
-    def run(values, starts, ends, valid):
+    def run(values, se):
+        starts, ends = se[0], se[1]
+        valid = ends > starts
         tree = build(values)
         out = query(tree, starts, ends, valid)
         return jnp.where(valid, out, 0)
@@ -169,6 +178,14 @@ class DeviceBatchHandle:
             dev_array.copy_to_host_async()
         except Exception:
             pass  # backends without async host copy: block() still works
+
+    def ready(self) -> bool:
+        """True when the device computation has finished (block() will
+        not stall).  False when the backend can't tell."""
+        try:
+            return bool(self._dev.is_ready())
+        except Exception:
+            return False
 
     def block(self) -> np.ndarray:
         with _DISPATCH_LOCK:
@@ -209,14 +226,12 @@ class WindowComputeEngine:
         T = len(next(iter(cols.values())))
         T_pad = next_pow2(T)
         B_pad = next_pow2(B)
-        valid = np.zeros(B_pad, dtype=bool)
-        valid[:B] = True
-        starts_p = np.zeros(B_pad, dtype=np.int32)
-        ends_p = np.zeros(B_pad, dtype=np.int32)
-        gwids_p = np.zeros(B_pad, dtype=np.int64)
-        starts_p[:B] = starts
-        ends_p[:B] = ends
-        gwids_p[:B] = gwids
+        # starts/ends ride in ONE packed int32 array: over a high-latency
+        # PJRT transport every device_put is a round trip, so the builtin
+        # paths ship exactly two buffers (values + extents) per launch
+        se = np.zeros((2, B_pad), dtype=np.int32)
+        se[0, :B] = starts
+        se[1, :B] = ends
 
         def pad_col(v, fill=0):
             out = np.full(T_pad, fill, dtype=self.dtype)
@@ -227,25 +242,26 @@ class WindowComputeEngine:
             _, comb, neutral = self.kind
             prog = _ffat_program(comb, neutral, T_pad)
             dev = prog(jnp.asarray(pad_col(cols[self.value_col], neutral)),
-                       jnp.asarray(starts_p), jnp.asarray(ends_p),
-                       jnp.asarray(valid))
+                       jnp.asarray(se))
         elif callable(self.kind):
+            valid = np.zeros(B_pad, dtype=bool)
+            valid[:B] = True
+            gwids_p = np.zeros(B_pad, dtype=np.int64)
+            gwids_p[:B] = gwids
             w_pad = next_pow2(int((ends - starts).max()) if B else 1)
             names = tuple(sorted(c for c in cols))
             padded = [pad_col(cols[c]) for c in names]
             prog = _custom_program(self.kind, w_pad, names)
-            dev = prog(jnp.asarray(gwids_p), jnp.asarray(starts_p),
-                       jnp.asarray(ends_p), jnp.asarray(valid), *padded)
+            dev = prog(jnp.asarray(gwids_p), jnp.asarray(se[0]),
+                       jnp.asarray(se[1]), jnp.asarray(valid), *padded)
         elif self.kind in ("max", "min"):
             fill = -np.inf if self.kind == "max" else np.inf
             n_levels = max(1, int(np.log2(T_pad)) + 1)
             prog = _sparse_table_program(self.kind, n_levels)
             dev = prog(jnp.asarray(pad_col(cols[self.value_col], fill)),
-                       jnp.asarray(starts_p), jnp.asarray(ends_p),
-                       jnp.asarray(valid))
+                       jnp.asarray(se))
         else:
             prog = _scan_program(self.kind)
             dev = prog(jnp.asarray(pad_col(cols[self.value_col])),
-                       jnp.asarray(starts_p), jnp.asarray(ends_p),
-                       jnp.asarray(valid))
+                       jnp.asarray(se))
         return DeviceBatchHandle(dev, B)

@@ -153,18 +153,21 @@ class NativeChannel:
         return self.lib.wfn_channel_size(self.ptr)
 
     def __del__(self):
-        lib, ptr = getattr(self, "lib", None), getattr(self, "ptr", None)
-        if lib is not None and ptr:
-            # drain remaining handles to avoid leaking references
-            handle = ctypes.c_size_t()
-            cid = ctypes.c_int()
-            while lib.wfn_channel_size(self.ptr):
-                if not lib.wfn_channel_get(self.ptr, ctypes.byref(handle),
-                                           ctypes.byref(cid)):
-                    break
-                obj = ctypes.cast(handle.value, ctypes.py_object).value
-                ctypes.pythonapi.Py_DecRef(ctypes.py_object(obj))
-            lib.wfn_channel_free(ptr)
+        try:
+            lib, ptr = getattr(self, "lib", None), getattr(self, "ptr", None)
+            if lib is not None and ptr:
+                # drain remaining handles to avoid leaking references
+                handle = ctypes.c_size_t()
+                cid = ctypes.c_int()
+                while lib.wfn_channel_size(self.ptr):
+                    if not lib.wfn_channel_get(self.ptr, ctypes.byref(handle),
+                                               ctypes.byref(cid)):
+                        break
+                    obj = ctypes.cast(handle.value, ctypes.py_object).value
+                    ctypes.pythonapi.Py_DecRef(ctypes.py_object(obj))
+                lib.wfn_channel_free(ptr)
+        except (TypeError, AttributeError):
+            pass  # interpreter shutdown: ctypes globals already torn down
 
 
 def pane_reduce(values, pos, kind: str):

@@ -128,14 +128,17 @@ class RtNode(threading.Thread):
             self.error = e
             traceback.print_exc()
         finally:
-            for o in self.outlets:
-                o.flush_eos()
+            # svc_end BEFORE closing outlets: teardown hooks (e.g. the
+            # device dispatcher abort) must stop emitting before the EOS
+            # sentinel is enqueued downstream
             try:
                 self.logic.svc_end()
             except BaseException as e:
                 if self.error is None:
                     self.error = e
                 traceback.print_exc()
+            for o in self.outlets:
+                o.flush_eos()
 
 
 class SourceLoopLogic(NodeLogic):
