@@ -35,14 +35,14 @@ def _probe_tpu(timeout_s: int = 150) -> bool:
     except subprocess.TimeoutExpired:
         return False
 
-N_EVENTS = 16_000_000
+N_EVENTS = 64_000_000
 SOURCE_PARALLELISM = 1
 N_KEYS = 64
 WIN = 4096
 SLIDE = 2048
-SOURCE_BATCH = 524_288
+SOURCE_BATCH = 1_048_576
 DEVICE_BATCH = 16_384
-MAX_BUFFER = 1 << 19
+MAX_BUFFER = 1 << 21
 INFLIGHT = 8
 HOST_BASELINE_EVENTS = 400_000
 
@@ -56,25 +56,30 @@ def run_tpu_graph(n_events, warmup=False):
 
     state = {}
     arange = np.arange(SOURCE_BATCH, dtype=np.int64)
+    # pregenerated templates: the metric is window-aggregation
+    # throughput, not host RNG / integer-division throughput.  The key
+    # pattern repeats exactly every SOURCE_BATCH events (SOURCE_BATCH %
+    # N_KEYS == 0) and per-key ids advance by SOURCE_BATCH // N_KEYS
+    # per batch, so each batch is the cached template plus one scalar.
+    assert SOURCE_BATCH % N_KEYS == 0
+    keys_t = arange % N_KEYS
+    ids_t = arange // N_KEYS
 
     def source(ctx):
         ridx = ctx.get_replica_index()
         st = state.setdefault(ridx, {
             "sent": 0,
-            # pregenerated value pool: the metric is window-aggregation
-            # throughput, not host RNG throughput
             "pool": np.random.default_rng(ridx).random(SOURCE_BATCH)})
         i = st["sent"]
         share = n_events // SOURCE_PARALLELISM
         if i >= share:
             return None
         n = min(SOURCE_BATCH, share - i)
-        ts = i + (arange if n == SOURCE_BATCH
-                  else np.arange(n, dtype=np.int64))
+        ids = ids_t[:n] + (i // N_KEYS)
         batch = TupleBatch({
-            "key": (ts + 7 * ridx) % N_KEYS,
-            "id": ts // N_KEYS,
-            "ts": ts // N_KEYS,
+            "key": keys_t[:n],
+            "id": ids,
+            "ts": ids,
             "value": st["pool"][:n],
         })
         st["sent"] = i + n
@@ -162,8 +167,8 @@ def main():
     run_tpu_graph(min(1_000_000, N_EVENTS // 8), warmup=True)
     from windflow_tpu.ops.window_compute import WindowComputeEngine
     eng = WindowComputeEngine("sum")
-    for b_pad in (256, 512, 1024, 2048, 4096):
-        for t_pad in (512, 1024, 2048, 4096):
+    for b_pad in (256, 512, 1024, 2048, 4096, 8192, 16384):
+        for t_pad in (512, 1024, 2048, 4096, 8192):
             h = eng.compute({"value": np.zeros(t_pad)},
                             np.zeros(b_pad, np.int64),
                             np.ones(b_pad, np.int64),

@@ -25,13 +25,54 @@ namespace {
 using i64 = long long;
 
 struct KeyState {
-    std::vector<i64> ids;     // sort keys (tuple id for CB, ts for TB)
+    std::vector<i64> ids;     // sort keys (tuple id for CB, ts for TB);
+                              // EMPTY while `dense` (ids implicit)
     std::vector<i64> ts;
     std::vector<double> vals;
     i64 next_fire = 0;        // next window (lwid) to fire
     i64 opened_max = -1;
     i64 max_id = -1;
     bool needs_sort = false;
+    // Dense fast lane: while every id arrives exactly one past the
+    // previous (the ordered-stream common case), the id column is never
+    // materialized -- vals[j] has id `dense_base + j`, pane edges are
+    // position arithmetic, and eviction is a prefix drop.  Any gap or
+    // reordering materializes the ids and falls back to the general
+    // sorted-column path for this key.
+    bool dense = true;
+    bool base_set = false;
+    i64 dense_base = 0;       // id of vals[0] (valid when base_set)
+
+    void materialize(i64 upto) {
+        ids.resize(vals.size());
+        for (i64 j = 0; j < upto; ++j) ids[j] = dense_base + j;
+        dense = false;
+    }
+
+    // Record one id at write position w: stays on the dense lane while
+    // ids arrive contiguously, otherwise materializes and falls back to
+    // the explicit sorted column.  `last` is the previous id (for the
+    // needs_sort check on the general path).
+    inline void append_id(i64 id, i64 w, i64 last) {
+        if (dense) {
+            if (!base_set) {
+                dense_base = id;
+                base_set = true;
+                return;
+            }
+            if (id == dense_base + w) return;
+            materialize(w);
+        }
+        ids[w] = id;
+        if (id < last) needs_sort = true;
+    }
+
+    // Position of the first tuple with sort key >= id on the dense lane.
+    inline i64 pos_of(i64 id) const {
+        i64 p = id - dense_base;
+        i64 sz = (i64)vals.size();
+        return p < 0 ? 0 : (p > sz ? sz : p);
+    }
 };
 
 struct Desc {
@@ -41,6 +82,10 @@ struct Desc {
 struct Engine {
     i64 win, slide, delay;
     bool is_tb;
+    bool renumber;            // ids are implicit per-key arrival order
+                              // (TS_RENUMBERING analogue): the id input
+                              // is ignored and every key stays on the
+                              // dense lane permanently
     i64 pane;                 // gcd(win, slide)
     std::unordered_map<i64, KeyState> keys;
     std::vector<Desc> ready;
@@ -63,8 +108,8 @@ struct Engine {
     std::vector<int32_t> slot_of;  // per-tuple dense index
     static constexpr i64 EMPTY = INT64_MIN;
 
-    Engine(i64 w, i64 s, bool tb, i64 d)
-        : win(w), slide(s), delay(tb ? d : 0), is_tb(tb),
+    Engine(i64 w, i64 s, bool tb, i64 d, bool renum)
+        : win(w), slide(s), delay(tb ? d : 0), is_tb(tb), renumber(renum),
           pane(std::gcd(w, s)) {
         tab_key.assign(1024, EMPTY);
         tab_state.assign(1024, nullptr);
@@ -141,14 +186,53 @@ struct Engine {
         d_max.assign(nd, INT64_MIN);
         for (std::size_t d = 0; d < nd; ++d) {
             KeyState& st = *d_state[d];
-            std::size_t base = st.ids.size();
-            st.ids.resize(base + d_count[d]);
+            std::size_t base = st.vals.size();
+            if (renumber) {
+                // implicit arrival-order ids: the anchor is the key's
+                // running tuple count, persisted across evictions
+                if (!st.base_set) {
+                    st.dense_base = 0;
+                    st.base_set = true;
+                }
+            } else if (base == 0) {
+                // empty state re-anchors the dense lane: contiguity is
+                // only needed for position arithmetic within the
+                // retained buffer, so a gap across a full eviction is
+                // harmless
+                st.dense = true;
+                st.base_set = false;
+                st.ids.clear();
+            }
+            if (!st.dense) st.ids.resize(base + d_count[d]);
             if (!is_tb) st.ts.resize(base + d_count[d]);
             st.vals.resize(base + d_count[d]);
             d_write[d] = (i64)base;
-            d_last[d] = base ? st.ids[base - 1] : INT64_MIN;
+            d_last[d] = base == 0 ? INT64_MIN
+                : (st.dense ? st.dense_base + (i64)base - 1
+                            : st.ids[base - 1]);
         }
-        if (is_tb) {
+        if (renumber) {
+            // ids input ignored; every key is permanently dense
+            if (is_tb) {
+                for (i64 j = 0; j < n; ++j) {
+                    int32_t d = slot_of[j];
+                    d_state[d]->vals[d_write[d]++] = vals[j];
+                }
+            } else {
+                for (i64 j = 0; j < n; ++j) {
+                    int32_t d = slot_of[j];
+                    KeyState& st = *d_state[d];
+                    i64 w = d_write[d]++;
+                    st.ts[w] = tss[j];
+                    st.vals[w] = vals[j];
+                }
+            }
+            for (std::size_t d = 0; d < nd; ++d) {
+                KeyState& st = *d_state[d];
+                d_min[d] = st.dense_base + d_write[d] - d_count[d];
+                d_max[d] = st.dense_base + d_write[d] - 1;
+            }
+        } else if (is_tb) {
             // TB: the sort key IS the timestamp; result timestamps come
             // from window arithmetic, so the ts column is never stored
             for (i64 j = 0; j < n; ++j) {
@@ -156,9 +240,8 @@ struct Engine {
                 KeyState& st = *d_state[d];
                 i64 w = d_write[d]++;
                 i64 id = ids[j];
-                st.ids[w] = id;
+                st.append_id(id, w, d_last[d]);
                 st.vals[w] = vals[j];
-                if (id < d_last[d]) st.needs_sort = true;
                 d_last[d] = id;
                 if (id < d_min[d]) d_min[d] = id;
                 if (id > d_max[d]) d_max[d] = id;
@@ -169,10 +252,9 @@ struct Engine {
                 KeyState& st = *d_state[d];
                 i64 w = d_write[d]++;
                 i64 id = ids[j];
-                st.ids[w] = id;
+                st.append_id(id, w, d_last[d]);
                 st.ts[w] = tss[j];
                 st.vals[w] = vals[j];
-                if (id < d_last[d]) st.needs_sort = true;
                 d_last[d] = id;
                 if (id < d_min[d]) d_min[d] = id;
                 if (id > d_max[d]) d_max[d] = id;
@@ -185,7 +267,11 @@ struct Engine {
             if (d_min[d] < accept_from) {
                 // late tuples behind the fired frontier: compact them
                 // out of the just-appended block (arrival order kept,
-                // matching the per-tuple skip of the scalar path)
+                // matching the per-tuple skip of the scalar path).
+                // A dense lane can hold late tuples only via its first
+                // anchor (contiguous ids never re-enter fired ground),
+                // so materialize before compacting.
+                if (st.dense) st.materialize((i64)st.vals.size());
                 i64 base = d_write[d] - d_count[d];
                 i64 w = base;
                 for (i64 r = base; r < d_write[d]; ++r) {
@@ -224,7 +310,7 @@ struct Engine {
     }
 
     void sort_key(KeyState& st) {
-        if (!st.needs_sort) return;
+        if (st.dense || !st.needs_sort) return;
         std::vector<std::size_t> idx(st.ids.size());
         std::iota(idx.begin(), idx.end(), 0);
         std::stable_sort(idx.begin(), idx.end(), [&](auto a, auto b) {
@@ -279,19 +365,31 @@ struct Engine {
             i64 n_panes = (max_end - base_key) / pane;
             i64 off = (i64)st_vals.size();
             base[key] = {off, base_key};
-            // pane partial sums via binary-searched edges
-            auto lo_it = st.ids.begin();
-            for (i64 p = 0; p < n_panes; ++p) {
-                i64 lo_key = base_key + p * pane;
-                i64 hi_key = lo_key + pane;
-                auto a = std::lower_bound(lo_it, st.ids.end(), lo_key);
-                auto b = std::lower_bound(a, st.ids.end(), hi_key);
-                double acc = 0.0;
-                for (auto v = a - st.ids.begin(), e = b - st.ids.begin();
-                     v < e; ++v)
-                    acc += st.vals[v];
-                st_vals.push_back(acc);
-                lo_it = b;
+            if (st.dense) {
+                // pane edges are pure position arithmetic on the dense
+                // lane
+                for (i64 p = 0; p < n_panes; ++p) {
+                    i64 a = st.pos_of(base_key + p * pane);
+                    i64 b = st.pos_of(base_key + (p + 1) * pane);
+                    double acc = 0.0;
+                    for (i64 v = a; v < b; ++v) acc += st.vals[v];
+                    st_vals.push_back(acc);
+                }
+            } else {
+                // pane partial sums via binary-searched edges
+                auto lo_it = st.ids.begin();
+                for (i64 p = 0; p < n_panes; ++p) {
+                    i64 lo_key = base_key + p * pane;
+                    i64 hi_key = lo_key + pane;
+                    auto a = std::lower_bound(lo_it, st.ids.end(), lo_key);
+                    auto b = std::lower_bound(a, st.ids.end(), hi_key);
+                    double acc = 0.0;
+                    for (auto v = a - st.ids.begin(),
+                              e = b - st.ids.begin(); v < e; ++v)
+                        acc += st.vals[v];
+                    st_vals.push_back(acc);
+                    lo_it = b;
+                }
             }
         }
         for (i64 d = 0; d < take; ++d) {
@@ -307,11 +405,18 @@ struct Engine {
                 // CB: result timestamp = ts of the last tuple in the
                 // window extent (matches the host engine / reference)
                 KeyState& st = keys[ds.key];
-                auto lo = std::lower_bound(st.ids.begin(), st.ids.end(),
-                                           ds.start);
-                auto hi = std::lower_bound(lo, st.ids.end(), ds.end);
-                st_rts.push_back(hi > lo
-                    ? st.ts[(hi - st.ids.begin()) - 1] : 0);
+                i64 lo, hi;
+                if (st.dense) {
+                    lo = st.pos_of(ds.start);
+                    hi = st.pos_of(ds.end);
+                } else {
+                    auto a = std::lower_bound(st.ids.begin(), st.ids.end(),
+                                              ds.start);
+                    auto b = std::lower_bound(a, st.ids.end(), ds.end);
+                    lo = a - st.ids.begin();
+                    hi = b - st.ids.begin();
+                }
+                st_rts.push_back(hi > lo ? st.ts[hi - 1] : 0);
             }
         }
         ready.erase(ready.begin(), ready.begin() + take);
@@ -330,10 +435,20 @@ struct Engine {
             auto qf = queued_floor.find(key);
             if (qf != queued_floor.end() && qf->second < keep_from)
                 keep_from = qf->second;
-            auto cut = std::lower_bound(st.ids.begin(), st.ids.end(),
-                                        keep_from) - st.ids.begin();
+            i64 cut;
+            if (st.dense) {
+                cut = keep_from - st.dense_base;
+                i64 sz = (i64)st.vals.size();
+                if (cut < 0) cut = 0;
+                if (cut > sz) cut = sz;
+                st.dense_base += cut;
+            } else {
+                cut = std::lower_bound(st.ids.begin(), st.ids.end(),
+                                       keep_from) - st.ids.begin();
+                if (cut > 0)
+                    st.ids.erase(st.ids.begin(), st.ids.begin() + cut);
+            }
             if (cut > 0) {
-                st.ids.erase(st.ids.begin(), st.ids.begin() + cut);
                 if (!is_tb)
                     st.ts.erase(st.ts.begin(), st.ts.begin() + cut);
                 st.vals.erase(st.vals.begin(), st.vals.begin() + cut);
@@ -358,8 +473,9 @@ struct Engine {
 
 extern "C" {
 
-void* wfn_engine_new(i64 win, i64 slide, int is_tb, i64 delay) {
-    return new Engine(win, slide, is_tb != 0, delay);
+void* wfn_engine_new(i64 win, i64 slide, int is_tb, i64 delay,
+                     int renumber) {
+    return new Engine(win, slide, is_tb != 0, delay, renumber != 0);
 }
 
 void wfn_engine_free(void* e) { delete static_cast<Engine*>(e); }

@@ -281,3 +281,103 @@ class TestPallasKernels:
         vals = np.arange(300, dtype=np.float32)
         out = window_sums(vals, np.array([5, 10, 0]), np.array([5, 11, 300]))
         np.testing.assert_allclose(out, [0.0, 10.0, vals.sum()], rtol=1e-4)
+
+
+def test_cb_eos_result_timestamps_full_graph():
+    """EOS-flushed CB windows must carry the last-extent-tuple ts, on
+    both the native renumbered lane and the Python fallback path
+    (regression: the Python eos_flush hardcoded rts=0)."""
+    from windflow_tpu.core.tuples import TupleBatch
+    from windflow_tpu.operators.batch_ops import BatchSource
+    from windflow_tpu.operators.tpu.win_seq_tpu import (WinSeqTPU,
+                                                        WinSeqTPULogic)
+
+    win, slide, n, n_keys = 64, 32, 20_000, 4
+    keys = np.arange(n, dtype=np.int64) % n_keys
+    ids = np.arange(n, dtype=np.int64) // n_keys
+    ts = ids * 7 + 3
+    vals = np.ones(n)
+    max_id = int(ids.max())
+
+    for force_python in (False, True):
+        batches = [TupleBatch({"key": keys[i:i + 4096], "id": ids[i:i + 4096],
+                               "ts": ts[i:i + 4096],
+                               "value": vals[i:i + 4096]})
+                   for i in range(0, n, 4096)]
+        it = iter(batches)
+        got = {}
+        lock = threading.Lock()
+
+        def sink(item):
+            if item is None:
+                return
+            with lock:
+                for i in range(len(item)):
+                    got[(int(item.key[i]), int(item.id[i]))] = int(item.ts[i])
+
+        g = wf.PipeGraph("t", Mode.DEFAULT)
+        op = WinSeqTPU("sum", win, slide, WinType.CB, batch_len=64,
+                       emit_batches=True)
+        g.add_source(BatchSource(lambda ctx: next(it, None))) \
+            .add(op).add_sink(wf.SinkBuilder(sink).build())
+        if force_python:
+            for node in g._all_nodes():
+                if isinstance(node.logic, WinSeqTPULogic):
+                    node.logic._native = None
+        g.run()
+        assert got, "no windows emitted"
+        for (k, wid), rts in got.items():
+            last_id = min(wid * slide + win - 1, max_id)
+            assert rts == last_id * 7 + 3, \
+                (force_python, k, wid, rts, last_id * 7 + 3)
+
+
+def test_native_engine_renumber_mode_matches_explicit_ids():
+    """Renumber mode (implicit arrival-order ids) must stage the same
+    windows as explicit dense ids."""
+    from windflow_tpu.runtime.native import (NativeWindowEngine,
+                                             native_available)
+    if not native_available():
+        pytest.skip("native runtime unavailable")
+    rng = np.random.default_rng(7)
+    n, n_keys = 30_000, 5
+    keys = rng.integers(0, n_keys, n).astype(np.int64)
+    # per-key arrival-order ids (what renumbering computes)
+    ids = np.zeros(n, np.int64)
+    counters = {}
+    for i, k in enumerate(keys):
+        ids[i] = counters.get(int(k), 0)
+        counters[int(k)] = ids[i] + 1
+    ts = np.arange(n, dtype=np.int64)
+    vals = rng.random(n)
+
+    def collect(renumber):
+        eng = NativeWindowEngine(48, 16, False, 0, renumber=renumber)
+        out = {}
+
+        def take(o):
+            if o is None:
+                return
+            _, st_, en_, dk, dg, dr = o
+            v = o[0]
+            for i in range(len(dk)):
+                s, e = int(st_[i]), int(en_[i])
+                out[(int(dk[i]), int(dg[i]))] = (round(float(v[s:e].sum()), 6),
+                                                 int(dr[i]))
+            return
+
+        for i in range(0, n, 4096):
+            # renumber mode ignores the id column entirely
+            bogus = np.zeros(min(4096, n - i), np.int64) if renumber \
+                else ids[i:i + 4096]
+            if eng.ingest(keys[i:i + 4096], bogus, ts[i:i + 4096],
+                          vals[i:i + 4096]) >= 64:
+                take(eng.flush(1 << 16))
+        eng.eos()
+        while eng.ready():
+            take(eng.flush(1 << 16))
+        return out
+
+    a = collect(renumber=True)
+    b = collect(renumber=False)
+    assert a == b and len(a) > 100

@@ -116,7 +116,7 @@ class _AsyncDispatcher:
                 # stalled-but-unterminated source doesn't withhold
                 # results until the pipeline refills to depth
                 while (pending and self.error is None
-                       and pending[0][0].ready()):
+                       and not self.aborting and pending[0][0].ready()):
                     try:
                         logic._finish(pending.popleft(), last_emit)
                     except BaseException as e:
@@ -132,7 +132,8 @@ class _AsyncDispatcher:
                 handle = engine.compute(cols, starts, ends, gwids)
                 logic.launched_batches += 1
                 pending.append((handle, descs, birth))
-                while len(pending) >= logic.inflight_depth:
+                while (len(pending) >= logic.inflight_depth
+                       and not self.aborting):
                     logic._finish(pending.popleft(), emit)
             except BaseException as e:  # surfaced on next submit / drain
                 self.error = e
@@ -223,7 +224,7 @@ class WinSeqTPULogic(NodeLogic):
         # window assignment, no renumbering, default value column
         self._native = None
         cfg = self.config
-        if (win_kind == "sum" and role == Role.SEQ and not renumbering
+        if (win_kind == "sum" and role == Role.SEQ
                 and cfg.n_outer == 1 and cfg.n_inner == 1
                 and cfg.id_outer == 0 and cfg.id_inner == 0
                 and value_of is None):
@@ -231,9 +232,12 @@ class WinSeqTPULogic(NodeLogic):
                 from ...runtime.native import (NativeWindowEngine,
                                                native_available)
                 if native_available():
+                    # renumbering = per-key arrival-order ids, which the
+                    # engine implements natively (ids implicit, always
+                    # on the dense lane)
                     self._native = NativeWindowEngine(
                         win_len, slide_len, win_type == WinType.TB,
-                        triggering_delay)
+                        triggering_delay, renumber=renumbering)
             except Exception:
                 self._native = None
 
@@ -663,8 +667,10 @@ class WinSeqTPULogic(NodeLogic):
                 start = initial_id + lwid * self.slide_len
                 end = start + self.win_len
                 gwid = wa.gwid_of_lwid(first_gwid, lwid, cfg)
+                # CB: -1 sentinel -> _launch resolves the result ts to
+                # the last tuple in the extent (same as the fired path)
                 rts = (gwid * self.slide_len + self.win_len - 1
-                       if self.win_type == WinType.TB else 0)
+                       if self.win_type == WinType.TB else -1)
                 self.descriptors.append((key, gwid, start, end, rts, key))
                 st.next_fire += 1
                 if len(self.descriptors) >= self.batch_len:
