@@ -381,3 +381,54 @@ def test_native_engine_renumber_mode_matches_explicit_ids():
     a = collect(renumber=True)
     b = collect(renumber=False)
     assert a == b and len(a) > 100
+
+
+class TestPallasFlatFATQuery:
+    """ops/pallas/flatfat_query.py vs the XLA query (flatfat_jax.py)."""
+
+    def _check(self, comb, neutral, n_leaves, B, seed=0):
+        import jax.numpy as jnp  # noqa: F401  (combine fns traced)
+        from windflow_tpu.ops.pallas.flatfat_query import flatfat_query_ranges
+        rng = np.random.default_rng(seed)
+        f = FlatFATJax(comb, neutral, n_leaves)
+        f.build(rng.normal(size=n_leaves).astype(np.float32))
+        starts = rng.integers(0, n_leaves - 1, B)
+        ends = np.minimum(starts + rng.integers(1, n_leaves // 2 + 2, B),
+                          n_leaves)
+        want = f.query_ranges(starts, ends)
+        got = flatfat_query_ranges(np.asarray(f.tree), starts, ends,
+                                   comb, neutral)
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_sum(self):
+        import jax.numpy as jnp
+        self._check(jnp.add, 0.0, 256, 64)
+
+    def test_max_min(self):
+        import jax.numpy as jnp
+        self._check(jnp.maximum, -np.inf, 1024, 128, seed=1)
+        self._check(jnp.minimum, np.inf, 64, 16, seed=2)
+
+    def test_non_commutative_order(self):
+        def left_weighted(a, b):
+            return a * 0.5 + b
+        self._check(left_weighted, 0.0, 128, 32, seed=3)
+
+    def test_engine_pallas_path_matches_xla(self, monkeypatch):
+        """WindowComputeEngine ffat kind through the pallas query gate."""
+        import jax.numpy as jnp
+        from windflow_tpu.ops import window_compute as wc
+        monkeypatch.setenv("WINDFLOW_PALLAS_FFAT", "1")
+        rng = np.random.default_rng(4)
+        T, B = 500, 40
+        vals = rng.normal(size=T)
+        starts = rng.integers(0, T - 1, B)
+        ends = np.minimum(starts + rng.integers(1, 80, B), T)
+        gwids = np.arange(B, dtype=np.int64)
+        eng = wc.WindowComputeEngine(("ffat", jnp.maximum, -np.inf))
+        got = eng.compute({"value": vals}, starts, ends, gwids).block()
+        monkeypatch.setenv("WINDFLOW_PALLAS_FFAT", "0")
+        eng2 = wc.WindowComputeEngine(("ffat", jnp.maximum, -np.inf))
+        want = eng2.compute({"value": vals}, starts, ends, gwids).block()
+        assert not wc._PALLAS_FFAT_BROKEN
+        np.testing.assert_allclose(got, want, rtol=1e-4)

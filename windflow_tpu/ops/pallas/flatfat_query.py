@@ -1,0 +1,133 @@
+"""Pallas TPU kernel: batched FlatFAT range queries.
+
+The TPU twin of the reference's ``ComputeResults_Kernel``
+(flatfat_gpu.hpp:92-135): there, one CUDA thread per window walks the
+device-resident aggregator tree with the bit-trick range decomposition;
+here, one grid program per window performs the same O(log n) walk with
+the whole heap-layout tree resident in VMEM (it is at most 2 x t_pad
+floats -- far under VMEM capacity for every bucketed batch shape the
+window engine produces).
+
+The walk keeps separate left/right partial accumulators so the combine
+order is preserved oldest->newest, which makes the kernel correct for
+non-commutative combines -- same contract as the XLA query in
+ops/flatfat_jax.py, against which the tests diff this kernel.
+
+Tree layout: flat [2n] heap (root at 1, leaves at [n, 2n)), reshaped to
+(2n / 128, 128) lane-rows.  Scalar tree loads become a dynamic-sublane
+row load plus a one-hot lane extract -- the TPU-shaped substitute for
+the scalar ``fat[i]`` indexing of the CUDA kernel.
+
+Build/update stay XLA level sweeps (flatfat_jax.py): they are
+bandwidth-bound strided combines XLA already fuses optimally; only the
+per-window query has the irregular access pattern worth hand-scheduling.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import numpy as np
+
+LANES = 128
+
+
+@functools.lru_cache(maxsize=None)
+def _build(n_leaves: int, n_windows: int, combine: Callable,
+           neutral: float, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    levels = int(np.log2(n_leaves))
+    assert 1 << levels == n_leaves, "FlatFAT capacity must be a power of two"
+
+    def kernel(starts_ref, ends_ref, tree_ref, out_ref):
+        b = pl.program_id(0)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (LANES,), 0)
+
+        def tload(idx):
+            """tree[idx] via dynamic row load + one-hot lane extract."""
+            row = idx // LANES
+            col = idx % LANES
+            rowvec = tree_ref[row, :]
+            return jnp.sum(jnp.where(lane == col, rowvec, 0.0))
+
+        def body(_, carry):
+            lo, hi, left, right = carry
+            take_l = (lo < hi) & ((lo & 1) == 1)
+            lval = tload(lo)
+            left = jnp.where(take_l, combine(left, lval), left)
+            lo = jnp.where(take_l, lo + 1, lo)
+            take_r = (lo < hi) & ((hi & 1) == 1)
+            rval = tload(jax.lax.max(hi - 1, 0))
+            right = jnp.where(take_r, combine(rval, right), right)
+            hi = jnp.where(take_r, hi - 1, hi)
+            return lo >> 1, hi >> 1, left, right
+
+        lo = starts_ref[b] + n_leaves
+        hi = ends_ref[b] + n_leaves
+        valid = hi > lo
+        lo, hi, left, right = jax.lax.fori_loop(
+            0, levels + 1, body,
+            (lo, hi, jnp.float32(neutral), jnp.float32(neutral)))
+        out = combine(left, right)
+        # one lane-row per window (1x1 output blocks are not lowerable;
+        # the host/caller reads column 0)
+        out_ref[b, :] = jnp.full((LANES,), jnp.where(valid, out, neutral),
+                                 jnp.float32)
+
+    n_out_rows = ((n_windows + 7) // 8) * 8
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_windows,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )
+
+    @jax.jit
+    def run(starts, ends, tree2d):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n_out_rows, LANES), jnp.float32),
+            grid_spec=grid_spec,
+            interpret=interpret,
+        )(starts, ends, tree2d)
+
+    return run
+
+
+def pad_tree_rows(tree, neutral: float):
+    """Pad a [2n] heap tree to a LANES multiple and reshape to the
+    (rows, LANES) layout the kernel expects.  jnp-traceable."""
+    import jax.numpy as jnp
+    tree = jnp.asarray(tree, jnp.float32)
+    two_n = tree.shape[0]
+    if two_n % LANES:
+        tree = jnp.concatenate(
+            [tree, jnp.full((LANES - two_n % LANES,), neutral,
+                            jnp.float32)])
+    return tree.reshape(-1, LANES)
+
+
+def flatfat_query_ranges(tree, starts, ends, combine: Callable,
+                         neutral: float, interpret: bool = None):
+    """out[b] = fold(combine, tree leaves [starts[b], ends[b]))  using
+    the heap tree (shape [2n], root at 1) built by flatfat_jax.
+
+    ``combine`` must be a jax-traceable binary fn forming a monoid with
+    ``neutral``; starts/ends index the leaf axis.  Returns float32 [B].
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu",)
+    tree = jnp.asarray(tree, jnp.float32)
+    n_leaves = tree.shape[0] // 2
+    B = len(starts)
+    run = _build(n_leaves, B, combine, float(neutral), bool(interpret))
+    out = run(jnp.asarray(starts, jnp.int32), jnp.asarray(ends, jnp.int32),
+              pad_tree_rows(tree, neutral))
+    return np.asarray(out)[:B, 0]

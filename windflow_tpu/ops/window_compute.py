@@ -159,6 +159,56 @@ def _ffat_program(combine: Callable, neutral: float, t_pad: int):
     return run
 
 
+# flat-buffer sizes whose tree fits comfortably in VMEM alongside the
+# batch (2 * t_pad f32 <= 4 MiB); larger buffers take the XLA query
+_PALLAS_FFAT_MAX_T = 1 << 19
+
+
+def _use_pallas_ffat(t_pad: int) -> bool:
+    """Pallas FFAT query gate: env override, else on for the TPU
+    backend (interpret mode on CPU is slower than the XLA query) for
+    trees that fit VMEM."""
+    import os
+    flag = os.environ.get("WINDFLOW_PALLAS_FFAT", "auto")
+    if flag in ("0", "off"):
+        return False
+    if flag in ("1", "on"):
+        return True
+    jax, _ = _jax()
+    return (jax.default_backend() == "tpu"
+            and t_pad <= _PALLAS_FFAT_MAX_T)
+
+
+# (t_pad, b_pad) shapes whose pallas lowering failed; those shapes fall
+# back to the XLA query permanently (first failure logged)
+_PALLAS_FFAT_BROKEN: set = set()
+
+
+@functools.lru_cache(maxsize=None)
+def _ffat_pallas_program(combine: Callable, neutral: float, t_pad: int,
+                         b_pad: int):
+    """XLA tree build + Pallas bit-walk range query (the hand-scheduled
+    ComputeResults_Kernel twin, ops/pallas/flatfat_query.py)."""
+    from .flatfat_jax import _programs
+    from .pallas.flatfat_query import _build as _pallas_build
+    jax, jnp = _jax()
+    build, _update, _query = _programs(combine, neutral, t_pad)
+    # interpret off TPU so forcing the gate on (tests) still runs
+    pq = _pallas_build(t_pad, b_pad, combine, float(neutral),
+                       jax.default_backend() != "tpu")
+
+    @jax.jit
+    def run(values, se):
+        starts, ends = se[0], se[1]
+        valid = ends > starts
+        tree = build(values)
+        from .pallas.flatfat_query import pad_tree_rows
+        out = pq(starts, ends, pad_tree_rows(tree, neutral))[:b_pad, 0]
+        return jnp.where(valid, out, 0)
+
+    return run
+
+
 class DeviceBatchHandle:
     """Async result of one batched window computation (the PJRT-future
     analogue of the reference's in-flight CUDA kernel).
@@ -240,9 +290,23 @@ class WindowComputeEngine:
 
         if self.is_ffat:
             _, comb, neutral = self.kind
-            prog = _ffat_program(comb, neutral, T_pad)
-            dev = prog(jnp.asarray(pad_col(cols[self.value_col], neutral)),
-                       jnp.asarray(se))
+            vals_dev = jnp.asarray(pad_col(cols[self.value_col], neutral))
+            se_dev = jnp.asarray(se)
+            dev = None
+            if (_use_pallas_ffat(T_pad)
+                    and (T_pad, B_pad) not in _PALLAS_FFAT_BROKEN):
+                try:
+                    dev = _ffat_pallas_program(comb, neutral, T_pad,
+                                               B_pad)(vals_dev, se_dev)
+                except Exception as e:
+                    # this shape falls back to the XLA query permanently
+                    _PALLAS_FFAT_BROKEN.add((T_pad, B_pad))
+                    import warnings
+                    warnings.warn(
+                        f"pallas FFAT query lowering failed for shape "
+                        f"(T={T_pad}, B={B_pad}); using XLA query: {e!r}")
+            if dev is None:
+                dev = _ffat_program(comb, neutral, T_pad)(vals_dev, se_dev)
         elif callable(self.kind):
             valid = np.zeros(B_pad, dtype=bool)
             valid[:B] = True
