@@ -142,3 +142,163 @@ def test_split_of_unsplit_select_rejected():
     pipe = g.add_source(wf.SourceBuilder(source_fn(5)).build())
     with pytest.raises(RuntimeError):
         pipe.select(0)
+
+
+def test_three_way_split_one_branch_sinks_others_merge():
+    """graph_tests/test_graph_9.cpp topology: 3-way split; one branch
+    terminates in its own sink, the other two continue (one through a
+    nested stage) and merge into the final sink."""
+    n = 120
+    early, final = SumSink(), SumSink()
+    g = wf.PipeGraph("g9", Mode.DEFAULT)
+    pipe = g.add_source(wf.SourceBuilder(source_fn(n)).build())
+    pipe.split(lambda t: int(t.value) % 3, 3)
+
+    def double(t):
+        t.value *= 2.0
+
+    b0 = pipe.select(0)
+    b0.add(wf.FilterBuilder(lambda t: t.value % 2 == 0).build())
+    b0.add(wf.MapBuilder(double).build())
+    b1 = pipe.select(1)
+    b1.add(wf.MapBuilder(double).build())
+    b2 = pipe.select(2)
+    b2.add_sink(wf.SinkBuilder(early).build())
+    merged = b0.merge(b1)
+    merged.add_sink(wf.SinkBuilder(final).build())
+    g.run()
+    r0 = [v for v in range(n) if v % 3 == 0 and v % 2 == 0]
+    r1 = [v for v in range(n) if v % 3 == 1]
+    r2 = [v for v in range(n) if v % 3 == 2]
+    assert early.total == sum(r2)
+    assert final.total == 2 * sum(r0) + 2 * sum(r1)
+
+
+def test_nested_split_inside_branch():
+    """Split inside a split branch (graph_tests test_graph_5/7 style):
+    outer split by %2, branch 1 splits again by %4, all leaves sink."""
+    n = 160
+    sinks = {"even": SumSink(), "one": SumSink(), "three": SumSink()}
+    g = wf.PipeGraph("nested", Mode.DEFAULT)
+    pipe = g.add_source(wf.SourceBuilder(source_fn(n)).build())
+    pipe.split(lambda t: int(t.value) % 2, 2)
+    pipe.select(0).add_sink(wf.SinkBuilder(sinks["even"]).build())
+    inner = pipe.select(1)
+    inner.split(lambda t: 0 if int(t.value) % 4 == 1 else 1, 2)
+    inner.select(0).add_sink(wf.SinkBuilder(sinks["one"]).build())
+    inner.select(1).add_sink(wf.SinkBuilder(sinks["three"]).build())
+    g.run()
+    assert sinks["even"].total == sum(v for v in range(n) if v % 2 == 0)
+    assert sinks["one"].total == sum(v for v in range(n) if v % 4 == 1)
+    assert sinks["three"].total == sum(v for v in range(n) if v % 4 == 3)
+
+
+def test_variadic_merge_three_pipes_then_split():
+    """Merge-of-three then split (merge-full + split composition,
+    graph_tests test_graph_3/6 style)."""
+    lo, hi = SumSink(), SumSink()
+    g = wf.PipeGraph("m3s", Mode.DEFAULT)
+    p1 = g.add_source(wf.SourceBuilder(source_fn(30)).build())
+    p2 = g.add_source(wf.SourceBuilder(source_fn(40)).build())
+    p3 = g.add_source(wf.SourceBuilder(source_fn(50)).build())
+    merged = p1.merge(p2, p3)
+    merged.split(lambda t: 0 if t.value < 20 else 1, 2)
+    merged.select(0).add_sink(wf.SinkBuilder(lo).build())
+    merged.select(1).add_sink(wf.SinkBuilder(hi).build())
+    g.run()
+    vals = list(range(30)) + list(range(40)) + list(range(50))
+    assert lo.total == sum(v for v in vals if v < 20)
+    assert hi.total == sum(v for v in vals if v >= 20)
+    assert lo.count + hi.count == 120
+
+
+def test_windowed_branch_inside_split_merges_back():
+    """A keyed window operator inside one split branch, merged with the
+    pass-through branch (graph_tests windowed-DAG style)."""
+    import math
+    sink = SumSink()
+    n = 200
+
+    def sum_win(gwid, it, result):
+        result.value = sum(t.value for t in it)
+
+    g = wf.PipeGraph("winbr", Mode.DEFAULT)
+    pipe = g.add_source(wf.SourceBuilder(source_fn(n)).build())
+    pipe.split(lambda t: int(t.value) % 2, 2)
+    b0 = pipe.select(0)
+    b0.add(wf.KeyFarmBuilder(sum_win).with_parallelism(2)
+           .with_cb_windows(5, 5).build())
+    b1 = pipe.select(1)
+    merged = b0.merge(b1)
+    merged.add_sink(wf.SinkBuilder(sink).build())
+    g.run()
+    # branch 0: evens, 4 keys -> per-key tumbling CB(5,5) windows cover
+    # every tuple exactly once (EOS flush included)
+    evens = sum(v for v in range(n) if v % 2 == 0)
+    odds = sum(v for v in range(n) if v % 2 == 1)
+    assert math.isclose(sink.total, evens + odds)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_randomized_parallelism_determinism_oracle(seed):
+    """The reference's correctness oracle (SURVEY.md §4): the same DAG
+    run with randomized operator parallelisms must produce an identical
+    global aggregate.  Sliding CB windows are order-sensitive, so (as in
+    the reference's mp test matrix) the graph runs DETERMINISTIC --
+    ordering collectors restore per-key id order ahead of the windows."""
+    import random
+    rng = random.Random(seed)
+    n = 240
+    totals = []
+    for _ in range(3):
+        p_map, p_filt, p_kf = (rng.randint(1, 5) for _ in range(3))
+        sink = SumSink()
+
+        def triple(t):
+            t.value *= 3.0
+
+        def sum_win(gwid, it, result):
+            result.value = sum(t.value for t in it)
+
+        g = wf.PipeGraph("oracle", Mode.DETERMINISTIC)
+        pipe = g.add_source(wf.SourceBuilder(source_fn(n)).build())
+        pipe.add(wf.MapBuilder(triple).with_parallelism(p_map).build())
+        pipe.add(wf.FilterBuilder(lambda t: int(t.value / 3) % 5 != 0)
+                 .with_parallelism(p_filt).build())
+        pipe.add(wf.KeyFarmBuilder(sum_win).with_parallelism(p_kf)
+                 .with_cb_windows(4, 2).build())
+        pipe.add_sink(wf.SinkBuilder(sink).build())
+        g.run()
+        totals.append(sink.total)
+    assert totals[0] == totals[1] == totals[2]
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_randomized_parallelism_tumbling_default_mode(seed):
+    """DEFAULT-mode variant: tumbling windows cover every tuple exactly
+    once, so the aggregate is order-independent and must match the
+    closed form under any parallelism mix."""
+    import random
+    rng = random.Random(100 + seed)
+    n = 240
+    expect = None
+    for _ in range(3):
+        p_map, p_kf = rng.randint(1, 5), rng.randint(1, 5)
+        sink = SumSink()
+
+        def triple(t):
+            t.value *= 3.0
+
+        def sum_win(gwid, it, result):
+            result.value = sum(t.value for t in it)
+
+        g = wf.PipeGraph("oracle-t", Mode.DEFAULT)
+        pipe = g.add_source(wf.SourceBuilder(source_fn(n)).build())
+        pipe.add(wf.MapBuilder(triple).with_parallelism(p_map).build())
+        pipe.add(wf.KeyFarmBuilder(sum_win).with_parallelism(p_kf)
+                 .with_cb_windows(6, 6).build())
+        pipe.add_sink(wf.SinkBuilder(sink).build())
+        g.run()
+        if expect is None:
+            expect = 3.0 * sum(range(n))
+        assert sink.total == expect
