@@ -16,6 +16,7 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <limits>
 #include <numeric>
 #include <unordered_map>
 #include <vector>
@@ -79,6 +80,8 @@ struct Desc {
     i64 key, lwid, start, end;
 };
 
+enum class Kind : int { SUM = 0, COUNT = 1, MAX = 2, MIN = 3 };
+
 struct Engine {
     i64 win, slide, delay;
     bool is_tb;
@@ -86,6 +89,7 @@ struct Engine {
                               // (TS_RENUMBERING analogue): the id input
                               // is ignored and every key stays on the
                               // dense lane permanently
+    Kind kind;                // builtin combine staged as pane partials
     i64 pane;                 // gcd(win, slide)
     std::unordered_map<i64, KeyState> keys;
     std::vector<Desc> ready;
@@ -108,9 +112,9 @@ struct Engine {
     std::vector<int32_t> slot_of;  // per-tuple dense index
     static constexpr i64 EMPTY = INT64_MIN;
 
-    Engine(i64 w, i64 s, bool tb, i64 d, bool renum)
+    Engine(i64 w, i64 s, bool tb, i64 d, bool renum, Kind k)
         : win(w), slide(s), delay(tb ? d : 0), is_tb(tb), renumber(renum),
-          pane(std::gcd(w, s)) {
+          kind(k), pane(std::gcd(w, s)) {
         tab_key.assign(1024, EMPTY);
         tab_state.assign(1024, nullptr);
         tab_stamp.assign(1024, -1);
@@ -309,6 +313,33 @@ struct Engine {
         }
     }
 
+    // one pane's partial over positions [a, b) of a key's value series,
+    // with the kind's neutral for empty panes
+    inline double pane_reduce(const KeyState& st, i64 a, i64 b) const {
+        switch (kind) {
+            case Kind::COUNT:
+                return (double)(b - a);
+            case Kind::MAX: {
+                double acc = -std::numeric_limits<double>::infinity();
+                for (i64 v = a; v < b; ++v)
+                    acc = std::max(acc, st.vals[v]);
+                return acc;
+            }
+            case Kind::MIN: {
+                double acc = std::numeric_limits<double>::infinity();
+                for (i64 v = a; v < b; ++v)
+                    acc = std::min(acc, st.vals[v]);
+                return acc;
+            }
+            case Kind::SUM:
+            default: {
+                double acc = 0.0;
+                for (i64 v = a; v < b; ++v) acc += st.vals[v];
+                return acc;
+            }
+        }
+    }
+
     void sort_key(KeyState& st) {
         if (st.dense || !st.needs_sort) return;
         std::vector<std::size_t> idx(st.ids.size());
@@ -371,23 +402,18 @@ struct Engine {
                 for (i64 p = 0; p < n_panes; ++p) {
                     i64 a = st.pos_of(base_key + p * pane);
                     i64 b = st.pos_of(base_key + (p + 1) * pane);
-                    double acc = 0.0;
-                    for (i64 v = a; v < b; ++v) acc += st.vals[v];
-                    st_vals.push_back(acc);
+                    st_vals.push_back(pane_reduce(st, a, b));
                 }
             } else {
-                // pane partial sums via binary-searched edges
+                // pane partials via binary-searched edges
                 auto lo_it = st.ids.begin();
                 for (i64 p = 0; p < n_panes; ++p) {
                     i64 lo_key = base_key + p * pane;
                     i64 hi_key = lo_key + pane;
                     auto a = std::lower_bound(lo_it, st.ids.end(), lo_key);
                     auto b = std::lower_bound(a, st.ids.end(), hi_key);
-                    double acc = 0.0;
-                    for (auto v = a - st.ids.begin(),
-                              e = b - st.ids.begin(); v < e; ++v)
-                        acc += st.vals[v];
-                    st_vals.push_back(acc);
+                    st_vals.push_back(pane_reduce(
+                        st, a - st.ids.begin(), b - st.ids.begin()));
                     lo_it = b;
                 }
             }
@@ -474,8 +500,9 @@ struct Engine {
 extern "C" {
 
 void* wfn_engine_new(i64 win, i64 slide, int is_tb, i64 delay,
-                     int renumber) {
-    return new Engine(win, slide, is_tb != 0, delay, renumber != 0);
+                     int renumber, int kind) {
+    return new Engine(win, slide, is_tb != 0, delay, renumber != 0,
+                      static_cast<Kind>(kind));
 }
 
 void wfn_engine_free(void* e) { delete static_cast<Engine*>(e); }

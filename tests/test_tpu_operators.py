@@ -432,3 +432,43 @@ class TestPallasFlatFATQuery:
         want = eng2.compute({"value": vals}, starts, ends, gwids).block()
         assert not wc._PALLAS_FFAT_BROKEN
         np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+@pytest.mark.parametrize("kind,agg", [("sum", np.sum), ("count", len),
+                                      ("max", np.max), ("min", np.min)])
+def test_native_engine_builtin_kinds_ground_truth(kind, agg):
+    """All builtin kinds through the native columnar engine vs numpy
+    (the C++ pane partials must use the kind's own reduction/neutral)."""
+    from windflow_tpu.core.tuples import TupleBatch
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPULogic
+    from windflow_tpu.runtime.native import native_available
+    if not native_available():
+        pytest.skip("native runtime unavailable")
+    rng = np.random.default_rng(5)
+    n, n_keys, win, slide = 20_000, 4, 96, 32
+    keys = np.arange(n, dtype=np.int64) % n_keys
+    ids = np.arange(n, dtype=np.int64) // n_keys
+    vals = rng.normal(size=n)
+    logic = WinSeqTPULogic(kind, win, slide, WinType.TB, batch_len=128,
+                           emit_batches=True)
+    assert logic._native is not None
+    ems = []
+    for i in range(0, n, 4096):
+        logic.svc(TupleBatch({"key": keys[i:i + 4096], "id": ids[i:i + 4096],
+                              "ts": ids[i:i + 4096],
+                              "value": vals[i:i + 4096]}), 0, ems.append)
+    logic.eos_flush(ems.append)
+    got = {}
+    for b in ems:
+        for i in range(len(b)):
+            got[(int(b.key[i]), int(b.id[i]))] = float(b["value"][i])
+    for k in range(n_keys):
+        kv = vals[keys == k]
+        lwid = 0
+        while lwid * slide <= len(kv) - 1:
+            seg = kv[lwid * slide: lwid * slide + win]
+            want = float(agg(seg))
+            assert (k, lwid) in got
+            assert abs(got[(k, lwid)] - want) <= 1e-3 * max(1, abs(want)), \
+                (kind, k, lwid, got[(k, lwid)], want)
+            lwid += 1

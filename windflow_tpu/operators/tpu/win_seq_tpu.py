@@ -224,7 +224,9 @@ class WinSeqTPULogic(NodeLogic):
         # window assignment, no renumbering, default value column
         self._native = None
         cfg = self.config
-        if (win_kind == "sum" and role == Role.SEQ
+        if (isinstance(win_kind, str)
+                and win_kind in ("sum", "count", "max", "min")
+                and role == Role.SEQ
                 and cfg.n_outer == 1 and cfg.n_inner == 1
                 and cfg.id_outer == 0 and cfg.id_inner == 0
                 and value_of is None):
@@ -237,7 +239,8 @@ class WinSeqTPULogic(NodeLogic):
                     # on the dense lane)
                     self._native = NativeWindowEngine(
                         win_len, slide_len, win_type == WinType.TB,
-                        triggering_delay, renumber=renumbering)
+                        triggering_delay, renumber=renumbering,
+                        kind=win_kind)
             except Exception:
                 self._native = None
 
@@ -525,8 +528,12 @@ class WinSeqTPULogic(NodeLogic):
         import time as _time
         birth = self._batch_birth or _time.perf_counter()
         self._batch_birth = None
+        # count windows sum their per-pane counts; max/min fold partials
+        # through the matching sparse-table engine (self.engine)
+        eng = self._count_engine() if self.engine.kind == "count" else None
         self._submit({"value": vals}, starts, ends, d_gwids,
-                     ("native", d_keys, d_gwids, d_rts), birth, emit)
+                     ("native", d_keys, d_gwids, d_rts), birth, emit,
+                     engine=eng)
 
     def _svc_batch_native(self, batch: TupleBatch, emit):
         import time as _time

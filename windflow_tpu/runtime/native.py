@@ -95,7 +95,7 @@ def get_lib():
         PD = ctypes.POINTER(ctypes.c_double)
         lib.wfn_engine_new.restype = ctypes.c_void_p
         lib.wfn_engine_new.argtypes = [LL, LL, ctypes.c_int, LL,
-                                       ctypes.c_int]
+                                       ctypes.c_int, ctypes.c_int]
         lib.wfn_engine_free.argtypes = [ctypes.c_void_p]
         lib.wfn_engine_ingest.restype = LL
         lib.wfn_engine_ingest.argtypes = [ctypes.c_void_p, PLL, PLL, PLL,
@@ -201,14 +201,17 @@ class NativeWindowEngine:
 
     __slots__ = ("lib", "ptr")
 
+    KINDS = {"sum": 0, "count": 1, "max": 2, "min": 3}
+
     def __init__(self, win_len: int, slide_len: int, is_tb: bool,
-                 delay: int = 0, renumber: bool = False):
+                 delay: int = 0, renumber: bool = False, kind: str = "sum"):
         self.lib = get_lib()
         if self.lib is None:
             raise RuntimeError("native runtime unavailable")
         self.ptr = self.lib.wfn_engine_new(win_len, slide_len,
                                            1 if is_tb else 0, delay,
-                                           1 if renumber else 0)
+                                           1 if renumber else 0,
+                                           self.KINDS[kind])
 
     def ingest(self, keys, ids, ts, vals) -> int:
         import numpy as np
