@@ -39,6 +39,43 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(arr, axis_names)
 
 
+def make_multihost_mesh(win_axis: int = 1,
+                        axis_names: Tuple[str, str] = ("key", "win")):
+    """Multi-host ('key', 'win') mesh with DCN/ICI-aware layout.
+
+    Keys are independent sub-streams (no steady-state cross-key
+    traffic), so the 'key' axis is laid across hosts -- its rare
+    collectives may ride DCN.  The 'win' axis carries the psum /
+    all_gather / ppermute combines of WMR / PF / ring paths, so it is
+    kept inside one host's slice where the collectives ride ICI
+    (the scaling-book rule: bandwidth-hungry axes on ICI, between-host
+    axes on DCN).
+
+    Single-process runs fall back to ``make_mesh`` over local devices.
+    Multi-host runs require ``jax.distributed.initialize()`` first (one
+    process per host, standard JAX multi-host bootstrap).
+    """
+    import jax
+
+    n_procs = jax.process_count()
+    if n_procs == 1:
+        return make_mesh(win_axis=win_axis, axis_names=axis_names)
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    local = jax.local_device_count()
+    if local % win_axis != 0:
+        raise ValueError(
+            f"{local} local devices not divisible by win_axis={win_axis}")
+    # hybrid mesh: first axis split across hosts (DCN), second within
+    # (ICI); axis order matches (key, win)
+    dev_mesh = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(local // win_axis, win_axis),
+        dcn_mesh_shape=(n_procs, 1),
+    )
+    return Mesh(dev_mesh, axis_names)
+
+
 def key_sharding(mesh, rank: int = 1):
     """NamedSharding placing axis 0 on 'key' (per-key state layout)."""
     from jax.sharding import NamedSharding, PartitionSpec as P

@@ -129,6 +129,75 @@ class ShardedWindowEngine:
         """One sharded streaming step; see _sharded_programs."""
         return self._step(values, starts, ends, stripe_values, pane_values)
 
+    def compute_pf_ring(self, pane_values, pane_len: int):
+        """Ring sequence-parallel pane combine: the ppermute alternative
+        to the all_gather PF path for long timelines.
+
+        The pane timeline is sharded in consecutive chunks over 'win'
+        (chip w holds panes [w*P_loc, (w+1)*P_loc)).  Sliding windows
+        starting in a chip's chunk need at most ``wpp - 1`` panes from
+        its right neighbours, fetched with ``hops`` one-step neighbour
+        ``ppermute``s -- O(hops * P_loc) ICI traffic per chip instead of
+        the all_gather's O(P_total), the ring-attention communication
+        pattern applied to the window axis.  Windows overrunning the
+        global timeline end are masked to the combine's neutral (0).
+
+        pane_values: [K, W_shards * P_loc, pane_len] sharded
+        ('key', 'win') on axis 0/1.  Returns [K, W_shards * P_loc // spp]
+        window sums, 'key'-sharded, windows in global time order.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        wpp = max(1, self.win_len // pane_len)    # panes per window
+        spp = max(1, self.slide_len // pane_len)  # panes per slide
+        W = self.n_win_shards
+        p_total = pane_values.shape[1]
+        p_loc = p_total // W
+        if p_loc % spp:
+            raise ValueError(
+                f"panes per shard ({p_loc}) must be a multiple of the "
+                f"slide ({spp} panes) for the ring layout")
+        hops = min(W - 1, -(-(wpp - 1) // p_loc))  # ceil, capped at ring
+        n_loc_wins = p_loc // spp
+
+        key = (id(self.mesh), wpp, spp, W, p_loc, pane_len)
+        if getattr(self, "_ring_key", None) != key:
+            perm = [(i, (i - 1) % W) for i in range(W)]
+
+            def ring_shard(pane_vals):
+                # [K, P_loc, pane_len] per shard
+                partials = jnp.sum(pane_vals, axis=-1)     # [K, P_loc]
+                blocks = [partials]
+                cur = partials
+                for _ in range(hops):
+                    # chip w receives chip (w+1)'s block: one ring hop
+                    cur = jax.lax.ppermute(cur, "win", perm)
+                    blocks.append(cur)
+                ext = jnp.concatenate(blocks, axis=-1)
+                starts_l = jnp.arange(n_loc_wins) * spp
+                idx = starts_l[:, None] + jnp.arange(wpp)[None, :]
+                # clamp only protects windows masked below (for every
+                # valid window g_start + wpp <= p_total implies the
+                # extent fits inside ext)
+                idx = jnp.minimum(idx, ext.shape[-1] - 1)
+                wins = jnp.sum(ext[:, idx], axis=-1)       # [K, n_loc]
+                # mask windows whose extent passes the global end (their
+                # ring reads wrapped around to chip 0)
+                w_id = jax.lax.axis_index("win")
+                g_start = w_id * p_loc + starts_l
+                ok = g_start + wpp <= p_total
+                return jnp.where(ok[None, :], wins, 0.0)
+
+            self._ring = jax.jit(jax.shard_map(
+                ring_shard, mesh=self.mesh,
+                in_specs=(P("key", "win", None),),
+                out_specs=P("key", "win"), check_vma=False))
+            self._ring_key = key
+        sh = NamedSharding(self.mesh, P("key", "win", None))
+        return self._ring(jax.device_put(pane_values, sh))
+
     def compute_kf(self, values, starts, ends):
         """Key-sharded window sums only (the Key_Farm-across-chips path
         used by operators.tpu.mesh_farm).  ``values`` is [K_shards, T],
