@@ -493,6 +493,116 @@ struct Engine {
             }
         }
     }
+
+    // -- checkpoint / resume ------------------------------------------
+    // Versioned binary snapshot of all mutable state (per-key series +
+    // fired-but-unstaged descriptors).  The reference has no
+    // checkpointing at all (SURVEY.md §5); this feeds the policy layer
+    // in utils/checkpoint.py through the Python state_dict hooks.
+    static constexpr i64 SNAP_MAGIC = 0x31'4E'46'57;  // "WFN1"
+
+    template <typename T>
+    static void put(std::vector<unsigned char>& b, const T& v) {
+        const unsigned char* p = reinterpret_cast<const unsigned char*>(&v);
+        b.insert(b.end(), p, p + sizeof(T));
+    }
+    template <typename T>
+    static void put_vec(std::vector<unsigned char>& b,
+                        const std::vector<T>& v) {
+        put<i64>(b, (i64)v.size());
+        const unsigned char* p =
+            reinterpret_cast<const unsigned char*>(v.data());
+        b.insert(b.end(), p, p + v.size() * sizeof(T));
+    }
+    template <typename T>
+    static bool get(const unsigned char*& p, const unsigned char* end,
+                    T& v) {
+        if (p + sizeof(T) > end) return false;
+        std::memcpy(&v, p, sizeof(T));
+        p += sizeof(T);
+        return true;
+    }
+    template <typename T>
+    static bool get_vec(const unsigned char*& p, const unsigned char* end,
+                        std::vector<T>& v) {
+        i64 n;
+        if (!get(p, end, n) || n < 0) return false;
+        if (p + n * (i64)sizeof(T) > end) return false;
+        v.resize(n);
+        std::memcpy(v.data(), p, n * sizeof(T));
+        p += n * sizeof(T);
+        return true;
+    }
+
+    std::vector<unsigned char> serialize() const {
+        std::vector<unsigned char> b;
+        put(b, SNAP_MAGIC);
+        put(b, win); put(b, slide); put(b, delay);
+        put(b, (i64)(is_tb ? 1 : 0));
+        put(b, (i64)(renumber ? 1 : 0));
+        put(b, (i64)kind);
+        put(b, (i64)keys.size());
+        for (const auto& [key, st] : keys) {
+            put(b, key);
+            put(b, st.next_fire); put(b, st.opened_max); put(b, st.max_id);
+            put(b, (i64)((st.dense ? 1 : 0) | (st.base_set ? 2 : 0)
+                         | (st.needs_sort ? 4 : 0)));
+            put(b, st.dense_base);
+            put_vec(b, st.ids);
+            put_vec(b, st.ts);
+            put_vec(b, st.vals);
+        }
+        put(b, (i64)ready.size());
+        for (const Desc& d : ready) {
+            put(b, d.key); put(b, d.lwid); put(b, d.start); put(b, d.end);
+        }
+        return b;
+    }
+
+    bool deserialize(const unsigned char* p, i64 len) {
+        const unsigned char* end = p + len;
+        i64 magic, w, s, d, tb, rn, kd, nk;
+        if (!get(p, end, magic) || magic != SNAP_MAGIC) return false;
+        if (!get(p, end, w) || !get(p, end, s) || !get(p, end, d)
+            || !get(p, end, tb) || !get(p, end, rn) || !get(p, end, kd))
+            return false;
+        // snapshot must match this engine's static configuration
+        if (w != win || s != slide || d != delay
+            || (tb != 0) != is_tb || (rn != 0) != renumber
+            || kd != (i64)kind)
+            return false;
+        if (!get(p, end, nk) || nk < 0) return false;
+        keys.clear();
+        ready.clear();
+        for (i64 i = 0; i < nk; ++i) {
+            i64 key, flags;
+            KeyState st;
+            if (!get(p, end, key) || !get(p, end, st.next_fire)
+                || !get(p, end, st.opened_max) || !get(p, end, st.max_id)
+                || !get(p, end, flags) || !get(p, end, st.dense_base)
+                || !get_vec(p, end, st.ids) || !get_vec(p, end, st.ts)
+                || !get_vec(p, end, st.vals))
+                return false;
+            st.dense = flags & 1;
+            st.base_set = flags & 2;
+            st.needs_sort = flags & 4;
+            keys.emplace(key, std::move(st));
+        }
+        i64 nr;
+        if (!get(p, end, nr) || nr < 0) return false;
+        for (i64 i = 0; i < nr; ++i) {
+            Desc ds;
+            if (!get(p, end, ds.key) || !get(p, end, ds.lwid)
+                || !get(p, end, ds.start) || !get(p, end, ds.end))
+                return false;
+            ready.push_back(ds);
+        }
+        // the scatter table caches KeyState pointers; rebuild lazily
+        tab_key.assign(tab_key.size(), EMPTY);
+        std::fill(tab_state.begin(), tab_state.end(), nullptr);
+        std::fill(tab_stamp.begin(), tab_stamp.end(), (i64)-1);
+        return p == end;
+    }
 };
 
 }  // namespace
@@ -537,6 +647,30 @@ i64 wfn_engine_flush(void* ep, i64 max_windows, double** vals, i64* n_vals,
     *gwids = e.st_gwids.data();
     *rts = e.st_rts.data();
     return b;
+}
+
+// Snapshot the engine's mutable state.  First call with buf=nullptr to
+// get the size; second call fills the caller's buffer.  Returns the
+// blob size, or -1 when the provided buffer is too small.
+i64 wfn_engine_serialize(void* ep, unsigned char* buf, i64 cap) {
+    Engine& e = *static_cast<Engine*>(ep);
+    std::vector<unsigned char> b = e.serialize();
+    if (buf == nullptr) return (i64)b.size();
+    if (cap < (i64)b.size()) return -1;
+    std::memcpy(buf, b.data(), b.size());
+    return (i64)b.size();
+}
+
+// Restore a snapshot; returns 1 on success, 0 on a malformed blob or a
+// configuration mismatch (the engine is left cleared in that case).
+int wfn_engine_deserialize(void* ep, const unsigned char* buf, i64 len) {
+    Engine& e = *static_cast<Engine*>(ep);
+    bool ok = e.deserialize(buf, len);
+    if (!ok) {  // never leave partially-restored state behind
+        e.keys.clear();
+        e.ready.clear();
+    }
+    return ok ? 1 : 0;
 }
 
 }  // extern "C"

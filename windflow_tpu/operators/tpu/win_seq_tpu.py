@@ -685,6 +685,41 @@ class WinSeqTPULogic(NodeLogic):
         self._launch(emit)
         self._drain_all(emit)
 
+    # -- checkpoint / resume (utils/checkpoint.py policy layer) --------
+    def state_dict(self):
+        """Pickle-friendly snapshot (quiescent contract: no device
+        batches in flight).  Native-path state is the engine's versioned
+        binary blob; Python-path state is the per-key store."""
+        st = {
+            "descriptors": list(self.descriptors),
+            "ignored_tuples": self.ignored_tuples,
+            "launched_batches": self.launched_batches,
+            "buffered": self._buffered_since_launch,
+        }
+        if self._native is not None:
+            st["native"] = self._native.serialize()
+        else:
+            st["keys"] = self.keys
+        return st
+
+    def load_state(self, state):
+        self.descriptors = list(state.get("descriptors", []))
+        self.ignored_tuples = state.get("ignored_tuples", 0)
+        self.launched_batches = state.get("launched_batches", 0)
+        self._buffered_since_launch = state.get("buffered", 0)
+        if "native" in state:
+            if self._native is None:
+                raise RuntimeError(
+                    "snapshot came from the native engine but this "
+                    "replica runs the Python path")
+            self._native.deserialize(state["native"])
+        else:
+            if self._native is not None:
+                raise RuntimeError(
+                    "snapshot came from the Python path but this "
+                    "replica runs the native engine")
+            self.keys = state["keys"]
+
     def svc_end(self):
         # error-path teardown: eos_flush already drained (and cleared)
         # the dispatcher on the normal path, so one still present here

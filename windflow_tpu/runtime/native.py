@@ -108,6 +108,12 @@ def get_lib():
             ctypes.c_void_p, LL, ctypes.POINTER(PD), PLL,
             ctypes.POINTER(PLL), ctypes.POINTER(PLL), ctypes.POINTER(PLL),
             ctypes.POINTER(PLL), ctypes.POINTER(PLL)]
+        lib.wfn_engine_serialize.restype = LL
+        lib.wfn_engine_serialize.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_char_p, LL]
+        lib.wfn_engine_deserialize.restype = ctypes.c_int
+        lib.wfn_engine_deserialize.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_char_p, LL]
         _lib = lib
         return lib
 
@@ -257,6 +263,21 @@ class NativeWindowEngine:
         return (arr(vals_p, nv, np.float64), arr(sp, b, np.int64),
                 arr(ep, b, np.int64), arr(kp, b, np.int64),
                 arr(gp, b, np.int64), arr(rp, b, np.int64))
+
+    def serialize(self) -> bytes:
+        """Versioned binary snapshot of all mutable engine state."""
+        n = self.lib.wfn_engine_serialize(self.ptr, None, 0)
+        buf = ctypes.create_string_buffer(n)
+        got = self.lib.wfn_engine_serialize(self.ptr, buf, n)
+        if got != n:
+            raise RuntimeError("engine snapshot size changed mid-call")
+        return buf.raw[:n]
+
+    def deserialize(self, blob: bytes) -> None:
+        """Restore a snapshot into an identically-configured engine."""
+        ok = self.lib.wfn_engine_deserialize(self.ptr, blob, len(blob))
+        if not ok:
+            raise ValueError("malformed or mismatched engine snapshot")
 
     def __del__(self):
         lib, ptr = getattr(self, "lib", None), getattr(self, "ptr", None)
