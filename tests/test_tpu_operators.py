@@ -472,3 +472,121 @@ def test_native_engine_builtin_kinds_ground_truth(kind, agg):
             assert abs(got[(k, lwid)] - want) <= 1e-3 * max(1, abs(want)), \
                 (kind, k, lwid, got[(k, lwid)], want)
             lwid += 1
+
+
+class TestResidentFFAT:
+    """rebuild=False mode: HBM-resident per-key forest, incremental
+    scatter updates (win_seqffat_gpu.hpp:150)."""
+
+    def _run(self, combine, win, slide, per_key=200, n_keys=3):
+        b = wf.WinSeqFFATTPUBuilder(lambda t: t.value, combine) \
+            .with_cb_windows(win, slide).with_rebuild(False)
+        coll = run_graph(b.build(), n_keys=n_keys, per_key=per_key)
+        return coll.by_key()
+
+    def test_max_sliding(self):
+        got = self._run("max", 24, 8)
+        expect = oracle(200, 24, 8, agg=max)
+        assert got == {k: expect for k in range(3)}
+
+    def test_sum_overlapping(self):
+        got = self._run("sum", 20, 4)
+        expect = oracle(200, 20, 4)
+        for k in range(3):
+            assert got[k].keys() == expect.keys()
+            for w in expect:
+                assert abs(got[k][w] - expect[w]) <= 1e-3 * max(
+                    1, abs(expect[w]))
+
+    def test_custom_combine(self):
+        import jax.numpy as jnp
+        b = wf.WinSeqFFATTPUBuilder(
+            lambda t: t.value, (jnp.minimum, float("inf"))) \
+            .with_cb_windows(12, 12).with_rebuild(False)
+        coll = run_graph(b.build())
+        expect = oracle(48, 12, 12, agg=min)
+        assert coll.by_key() == {k: expect for k in range(3)}
+
+    def test_rebuild_false_rejects_tb(self):
+        with pytest.raises(ValueError):
+            wf.WinSeqFFATTPUBuilder(lambda t: t.value, "sum") \
+                .with_tb_windows(10, 5).with_rebuild(False).build()
+
+    def test_many_keys_grow_forest(self):
+        """Key count beyond the initial forest capacity forces growth."""
+        b = wf.WinSeqFFATTPUBuilder(lambda t: t.value, "sum") \
+            .with_cb_windows(8, 8).with_rebuild(False)
+        coll = run_graph(b.build(), n_keys=40, per_key=16)
+        got = coll.by_key()
+        expect = oracle(16, 8, 8)
+        assert len(got) == 40
+        for k in range(40):
+            for w in expect:
+                assert abs(got[k][w] - expect[w]) <= 1e-3
+
+    def test_checkpoint_roundtrip(self):
+        import pickle
+        from windflow_tpu.operators.tpu.ffat_resident import \
+            WinSeqFFATResidentLogic
+        import jax.numpy as jnp
+        mk = lambda: WinSeqFFATResidentLogic(
+            lambda t: t.value, jnp.add, 0.0, 16, 8)
+        a, out = mk(), []
+        for i in range(60):
+            a.svc(BasicRecord(i % 2, i // 2, i // 2, float(i)), 0,
+                  out.append)
+        blob = pickle.dumps(a.state_dict())
+        b, out2 = mk(), []
+        b.load_state(pickle.loads(blob))
+        ref, out3 = mk(), []
+        for i in range(120):
+            ref.svc(BasicRecord(i % 2, i // 2, i // 2, float(i)), 0,
+                    out3.append)
+        for i in range(60, 120):
+            b.svc(BasicRecord(i % 2, i // 2, i // 2, float(i)), 0,
+                  out2.append)
+        ref.eos_flush(out3.append)
+        b.eos_flush(out2.append)
+        want = {(r.key, r.id): r.value for r in out3}
+        got = {(r.key, r.id): r.value for r in out + out2}
+        assert want.keys() == got.keys()
+        for k in want:
+            assert abs(want[k] - got[k]) <= 1e-3 * max(1, abs(want[k]))
+
+    def test_window_fires_on_completing_tuple(self):
+        """Liveness: the tuple that completes a window must fire it
+        immediately, not the next one (record-at-a-time path)."""
+        from windflow_tpu.operators.tpu.ffat_resident import \
+            WinSeqFFATResidentLogic
+        import jax.numpy as jnp
+        lg = WinSeqFFATResidentLogic(lambda t: t.value, jnp.add, 0.0, 16, 8)
+        out = []
+        for i in range(16):
+            lg.svc(BasicRecord(0, i, i * 3, float(i)), 0, out.append)
+        assert len(out) == 1 and out[0].value == sum(range(16))
+        # CB result ts = last tuple in extent
+        assert out[0].ts == 15 * 3
+
+    def test_restore_into_smaller_default_instance(self):
+        """Restoring a snapshot must pin the forest to the snapshot's
+        row count so new keys never alias checkpointed rows."""
+        import pickle
+        from windflow_tpu.operators.tpu.ffat_resident import \
+            WinSeqFFATResidentLogic
+        import jax.numpy as jnp
+        a = WinSeqFFATResidentLogic(lambda t: t.value, jnp.add, 0.0, 8, 8,
+                                    initial_keys=2)
+        out = []
+        for i in range(4 * 8):  # 4 keys -> forest grows past 2 rows
+            a.svc(BasicRecord(i % 4, i // 4, 0, 1.0), 0, out.append)
+        blob = pickle.dumps(a.state_dict())
+        b = WinSeqFFATResidentLogic(lambda t: t.value, jnp.add, 0.0, 8, 8)
+        b.load_state(pickle.loads(blob))
+        out2 = []
+        for i in range(6 * 8):  # two NEW keys (4, 5) post-restore
+            b.svc(BasicRecord(i % 6, i // 6, 0, 2.0), 0, out2.append)
+        by_key = {}
+        for r in out2:
+            by_key.setdefault(r.key, []).append(r.value)
+        # new keys' windows must hold only their own values (8 x 2.0)
+        assert by_key[4] == [16.0] and by_key[5] == [16.0]

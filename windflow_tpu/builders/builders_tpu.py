@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
-from ..core.basic import OptLevel
+from ..core.basic import OptLevel, WinType
 from ..operators.tpu.farms_tpu import (KeyFarmTPU, KeyFFATTPU, PaneFarmTPU,
                                        WinFarmTPU, WinMapReduceTPU,
                                        WinSeqFFATTPU)
@@ -198,14 +198,52 @@ class WinSeqFFATTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
 
     _default_name = "win_seqffat_tpu"
 
+    _BUILTIN_COMBINES = {"sum": (None, 0.0), "max": (None, float("-inf")),
+                         "min": (None, float("inf"))}
+
     def __init__(self, lift, combine):
         super().__init__(lift)
         self.combine = combine
         self.batch_len = DEFAULT_BATCH_LEN
         self.device_index = 0
+        self.rebuild = True
 
-    def build(self) -> WinSeqFFATTPU:
+    def with_rebuild(self, rebuild: bool):
+        """rebuild=True (default): the tree is rebuilt from the staged
+        flat buffer every device launch.  rebuild=False: the per-key
+        forest stays resident in HBM and is incrementally updated (the
+        Win_SeqFFAT_GPU ``rebuild`` flag, win_seqffat_gpu.hpp:150);
+        count-based windows only."""
+        self.rebuild = rebuild
+        return self
+
+    withRebuild = with_rebuild
+
+    def _resident_combine(self):
+        if isinstance(self.combine, tuple) and len(self.combine) == 2:
+            return self.combine
+        if isinstance(self.combine, str) \
+                and self.combine in self._BUILTIN_COMBINES:
+            import jax.numpy as jnp
+            fn = {"sum": jnp.add, "max": jnp.maximum,
+                  "min": jnp.minimum}[self.combine]
+            return fn, self._BUILTIN_COMBINES[self.combine][1]
+        raise ValueError(
+            "resident (rebuild=False) mode needs a (jax_fn, neutral) "
+            "combine or one of sum/max/min")
+
+    def build(self):
         self._check_windows()
+        if not self.rebuild:
+            from ..operators.tpu.ffat_resident import WinSeqFFATResident
+            if self.win_type != WinType.CB:
+                raise ValueError("rebuild=False supports count-based "
+                                 "windows only (use the rebuild path "
+                                 "for time-based)")
+            fn, neutral = self._resident_combine()
+            return WinSeqFFATResident(self.fn, fn, neutral, self.win_len,
+                                      self.slide_len, self.name,
+                                      self.result_factory)
         return WinSeqFFATTPU(self.fn, self.combine, self.win_len,
                              self.slide_len, self.win_type, self.batch_len,
                              self.triggering_delay, self.name,

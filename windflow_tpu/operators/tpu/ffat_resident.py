@@ -1,0 +1,212 @@
+"""Resident-tree FFAT window logic: the ``rebuild=false`` incremental
+mode of the reference's Win_SeqFFAT_GPU.
+
+Where the batch engine (WinSeqTPULogic with an ffat kind) rebuilds the
+aggregator tree from a staged flat buffer every launch, this logic keeps
+one FlatFAT per key **resident in HBM across batches** as a key-batched
+forest (ops/flatfat_jax.BatchedFlatFAT) and only scatters the new
+lifted leaves plus their root paths -- the circular-buffer tree update
+of the reference (win_seqffat_gpu.hpp:150 ``rebuild`` flag;
+UpdateTreeLevel_Kernel, flatfat_gpu.hpp:68-82).
+
+Scope: count-based windows over per-key arrival order (one tuple per
+leaf; ring position = arrival index mod capacity).  Time-based streams
+keep the rebuild path (the builder routes them there).  Ring capacity
+is sized to win_len + chunk headroom, and every svc call fires + queries
+due windows before their leaves can be overwritten.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+from ...core.basic import (OrderingMode, Pattern, Role, RoutingMode,
+                           WinType)
+from ...core.tuples import BasicRecord, TupleBatch
+from ...runtime.emitters import StandardEmitter
+from ...runtime.node import EOSMarker, NodeLogic
+from ..base import Operator, StageSpec
+
+
+class _ResidentKey:
+    __slots__ = ("row", "count", "next_fire", "ts_ring")
+
+    def __init__(self, row: int, capacity: int):
+        self.row = row
+        self.count = 0      # tuples received = next leaf id
+        self.next_fire = 0  # next window (lwid) to fire
+        # host-side timestamp ring mirroring the leaf ring, so CB
+        # results carry the last-extent-tuple ts like every other path
+        self.ts_ring = np.zeros(capacity, np.int64)
+
+
+class WinSeqFFATResidentLogic(NodeLogic):
+    def __init__(self, lift: Callable, combine: Callable, neutral: float,
+                 win_len: int, slide_len: int, *,
+                 result_factory=BasicRecord, initial_keys: int = 16):
+        from ...ops.flatfat_jax import BatchedFlatFAT
+        if win_len == 0 or slide_len == 0:
+            raise ValueError("win_len and slide_len must be > 0")
+        self.lift = lift
+        self.combine = combine
+        self.neutral = float(neutral)
+        self.win_len = win_len
+        self.slide_len = slide_len
+        self.result_factory = result_factory
+        # capacity: window span + one slide of update headroom, pow2
+        need = win_len + slide_len
+        self._chunk_headroom = max(slide_len, 1024)
+        n = 1
+        while n < need + self._chunk_headroom:
+            n <<= 1
+        self.capacity = n
+        self.keys: Dict[Any, _ResidentKey] = {}
+        self.forest = BatchedFlatFAT(combine, self.neutral,
+                                     max(2, initial_keys), n)
+        self.launched_batches = 0
+
+    def _key_state(self, key) -> _ResidentKey:
+        st = self.keys.get(key)
+        if st is None:
+            row = len(self.keys)
+            if row >= self.forest.n_keys:
+                self._grow_forest()
+            st = self.keys[key] = _ResidentKey(row, self.capacity)
+        return st
+
+    def _grow_forest(self) -> None:
+        """Double the key capacity, copying the resident trees."""
+        import jax.numpy as jnp
+        old = self.forest.tree
+        from ...ops.flatfat_jax import BatchedFlatFAT
+        self.forest = BatchedFlatFAT(self.combine, self.neutral,
+                                     old.shape[0] * 2, self.capacity)
+        self.forest.tree = jnp.concatenate(
+            [old, jnp.full(old.shape, self.neutral, old.dtype)])
+
+    # -- ingest --------------------------------------------------------
+    def _ingest_chunk(self, rows, ids, lifted, key_objs, emit) -> None:
+        """One forest update + fire/query pass (chunk small enough that
+        no due window's leaves can be overwritten)."""
+        self.forest.update(rows, ids, lifted)
+        qk_rows: List[int] = []
+        qs: List[int] = []
+        qe: List[int] = []
+        meta: List = []
+        for key in key_objs:
+            st = self.keys[key]
+            while st.count >= st.next_fire * self.slide_len + self.win_len:
+                lwid = st.next_fire
+                start = lwid * self.slide_len
+                qk_rows.append(st.row)
+                qs.append(start)
+                qe.append(start + self.win_len)
+                meta.append((key, lwid))
+                st.next_fire += 1
+        if qk_rows:
+            self._emit_windows(qk_rows, qs, qe, meta, emit)
+
+    def _emit_windows(self, rows, qs, qe, meta, emit) -> None:
+        res = self.forest.query(np.asarray(rows), np.asarray(qs),
+                                np.asarray(qe))
+        self.launched_batches += 1
+        for (key, lwid), end, val in zip(meta, qe, res):
+            out = self.result_factory()
+            out.value = float(val)
+            # CB convention: result ts = last tuple in the extent
+            rts = int(self.keys[key].ts_ring[(end - 1) % self.capacity])
+            out.set_control_fields(key, lwid, rts)
+            emit(out)
+
+    def svc(self, item, channel_id, emit):
+        if isinstance(item, EOSMarker):
+            return
+        if isinstance(item, TupleBatch):
+            keys = item.key
+            vals = item["value"]
+            tss = item.ts
+            if len(keys) > 1 and not np.all(keys[:-1] <= keys[1:]):
+                order = np.argsort(keys, kind="stable")
+                keys, vals, tss = keys[order], vals[order], tss[order]
+            edges = np.nonzero(np.diff(keys))[0] + 1
+            bounds = np.concatenate([[0], edges, [len(keys)]])
+            # chunk so no key advances further than the ring headroom
+            # between fire/query passes
+            step = self._chunk_headroom
+            for j in range(len(bounds) - 1):
+                key = keys[bounds[j]].item()
+                st = self._key_state(key)
+                lo, hi = int(bounds[j]), int(bounds[j + 1])
+                for c in range(lo, hi, step):
+                    d = min(c + step, hi)
+                    ids = np.arange(st.count, st.count + (d - c))
+                    st.ts_ring[ids % self.capacity] = tss[c:d]
+                    st.count += d - c
+                    self._ingest_chunk(
+                        np.full(d - c, st.row), ids,
+                        vals[c:d].astype(np.float32), [key], emit)
+            return
+        key, _tid, ts = item.get_control_fields()
+        st = self._key_state(key)
+        lifted = self.lift(item)
+        st.ts_ring[st.count % self.capacity] = ts
+        st.count += 1
+        self._ingest_chunk([st.row], [st.count - 1], [lifted], [key], emit)
+
+    def eos_flush(self, emit):
+        """Fire partial tail windows whose extent clips at the stream
+        end (the EOS flush of open windows, win_seq.hpp:514-579)."""
+        rows, qs, qe, meta = [], [], [], []
+        for key, st in self.keys.items():
+            while st.next_fire * self.slide_len < st.count:
+                lwid = st.next_fire
+                start = lwid * self.slide_len
+                rows.append(st.row)
+                qs.append(start)
+                qe.append(min(start + self.win_len, st.count))
+                meta.append((key, lwid))
+                st.next_fire += 1
+        if rows:
+            self._emit_windows(rows, qs, qe, meta, emit)
+
+    # -- checkpoint ----------------------------------------------------
+    def state_dict(self):
+        return {"keys": {k: (st.row, st.count, st.next_fire,
+                             st.ts_ring.copy())
+                         for k, st in self.keys.items()},
+                "tree": np.asarray(self.forest.tree)}
+
+    def load_state(self, state):
+        import jax.numpy as jnp
+        from ...ops.flatfat_jax import BatchedFlatFAT
+        tree = state["tree"]
+        # the forest must match the snapshot's row count EXACTLY: a
+        # larger n_keys would let jnp clamp out-of-range rows silently,
+        # aliasing new keys onto the last checkpointed tree
+        self.forest = BatchedFlatFAT(self.combine, self.neutral,
+                                     tree.shape[0], self.capacity)
+        self.forest.tree = jnp.asarray(tree)
+        self.keys.clear()
+        for k, (row, count, nf, ts_ring) in state["keys"].items():
+            st = _ResidentKey(row, self.capacity)
+            st.count, st.next_fire = count, nf
+            st.ts_ring = np.asarray(ts_ring).copy()
+            self.keys[k] = st
+
+
+class WinSeqFFATResident(Operator):
+    """Standalone resident-tree FFAT operator (rebuild=false mode)."""
+
+    def __init__(self, lift, combine, neutral, win_len, slide_len,
+                 name="win_seqffat_resident", result_factory=BasicRecord):
+        super().__init__(name, 1, RoutingMode.FORWARD,
+                         Pattern.WIN_SEQFFAT_TPU)
+        self.kwargs = dict(lift=lift, combine=combine, neutral=neutral,
+                           win_len=win_len, slide_len=slide_len,
+                           result_factory=result_factory)
+
+    def stages(self):
+        logic = WinSeqFFATResidentLogic(**self.kwargs)
+        return [StageSpec(self.name, [logic], StandardEmitter(),
+                          self.routing, ordering_mode=OrderingMode.ID)]

@@ -85,6 +85,154 @@ def _programs(combine: Callable, neutral: float, n: int):
     return build, update, query_ranges
 
 
+@functools.lru_cache(maxsize=None)
+def _batched_programs(combine: Callable, neutral: float, n: int):
+    """Key-batched device-resident trees [K, 2n]: the incremental
+    (rebuild=false) mode of the reference, where the aggregator tree
+    stays on the device between batches and only touched paths are
+    recomputed (UpdateTreeLevel_Kernel, flatfat_gpu.hpp:68-82) --
+    vectorized here as log n scatter rounds over the update batch."""
+    import jax
+    import jax.numpy as jnp
+
+    levels = int(np.log2(n))
+    assert 1 << levels == n, "FlatFAT capacity must be a power of two"
+
+    @jax.jit
+    def update_sparse(tree, keys, positions, values, valid):
+        """Scatter new leaves at (key, pos) then recompute ONLY the
+        touched root paths: O(B log n) work independent of K and n.
+        Duplicate parents scatter identical recomputed values, so
+        in-batch collisions are benign."""
+        safe_k = jnp.where(valid, keys, 0)
+        # invalid lanes write heap slot 0 -- never read (root lives at
+        # 1) and never a valid target, so duplicate-index scatters
+        # cannot clobber a real update with a stale value
+        idx = jnp.where(valid, positions + n, 0)
+        tree = tree.at[safe_k, idx].set(
+            jnp.where(valid, values, tree[safe_k, idx]))
+        for _ in range(levels):
+            parent = idx >> 1
+            left = tree[safe_k, 2 * parent]
+            right = tree[safe_k, 2 * parent + 1]
+            tree = tree.at[safe_k, parent].set(
+                jnp.where(valid, combine(left, right),
+                          tree[safe_k, parent]))
+            idx = parent
+        return tree
+
+    @jax.jit
+    def query_ranges(tree, keys, starts, ends, valid):
+        """Per-window fold over leaf ring positions [start, end) of each
+        window's key tree; same bit-walk as the single-tree query."""
+        safe_k = jnp.where(valid, keys, 0)
+        lo = starts + n
+        hi = ends + n
+        left = jnp.full(starts.shape, neutral, tree.dtype)
+        right = jnp.full(starts.shape, neutral, tree.dtype)
+        for _ in range(levels + 1):
+            take_l = (lo < hi) & (lo & 1).astype(bool)
+            left = jnp.where(take_l, combine(left, tree[safe_k, lo]), left)
+            lo = jnp.where(take_l, lo + 1, lo)
+            take_r = (lo < hi) & (hi & 1).astype(bool)
+            hi_idx = jnp.where(take_r, hi - 1, hi)
+            right = jnp.where(take_r,
+                              combine(tree[safe_k, hi_idx], right), right)
+            hi = hi_idx
+            lo = lo >> 1
+            hi = hi >> 1
+        out = combine(left, right)
+        return jnp.where(valid, out, neutral)
+
+    return update_sparse, query_ranges
+
+
+class BatchedFlatFAT:
+    """Device-resident per-key FlatFAT forest (the ``rebuild=false``
+    incremental mode of Win_SeqFFAT_GPU).
+
+    One [K, 2n] array holds every key's aggregator tree in HBM across
+    batches; leaves form a circular buffer over each key's series
+    (leaf position = id % n, the reference's circular level update),
+    so capacity ``n_leaves`` must cover the window span.  Updates touch
+    only the modified root paths; range queries that wrap the ring are
+    answered in two ordered pieces to preserve non-commutative combine
+    order (oldest -> newest)."""
+
+    def __init__(self, combine: Callable, neutral: float, n_keys: int,
+                 n_leaves: int, dtype=np.float32):
+        n = 1
+        while n < max(2, n_leaves):
+            n <<= 1
+        self.n = n
+        self.n_keys = n_keys
+        self.neutral = neutral
+        self.combine = combine
+        self._update, self._query = _batched_programs(combine, neutral, n)
+        import jax.numpy as jnp
+        self.tree = jnp.full((n_keys, 2 * n), neutral, dtype)
+        # leaves [n, 2n) start as neutral; internal nodes of a
+        # neutral-filled tree are neutral (monoid identity), so no
+        # build pass is needed
+
+    def update(self, keys, ids, values) -> None:
+        """Insert values at ring positions ids % n for their keys."""
+        import jax.numpy as jnp
+        keys = np.asarray(keys)
+        b = 1
+        while b < max(1, len(keys)):
+            b <<= 1
+        k = np.zeros(b, np.int32)
+        p = np.zeros(b, np.int32)
+        v = np.full(b, self.neutral, np.float32)
+        ok = np.zeros(b, bool)
+        k[: len(keys)] = keys
+        p[: len(keys)] = np.asarray(ids) % self.n
+        v[: len(keys)] = values
+        ok[: len(keys)] = True
+        self.tree = self._update(self.tree, jnp.asarray(k), jnp.asarray(p),
+                                 jnp.asarray(v), jnp.asarray(ok))
+
+    def query(self, keys, starts, ends) -> np.ndarray:
+        """Window results for extents [starts, ends) in id space (end -
+        start <= n); wrapping ranges are combined as (tail, head) to
+        keep time order."""
+        import jax.numpy as jnp
+        keys = np.asarray(keys, np.int64)
+        starts = np.asarray(starts, np.int64)
+        ends = np.asarray(ends, np.int64)
+        if np.any(ends - starts > self.n):
+            raise ValueError("window extent exceeds tree capacity")
+        s = starts % self.n
+        e_raw = ends % self.n
+        wraps = (ends > starts) & (e_raw <= s)
+        B = len(keys)
+        b = 1
+        while b < max(1, 2 * B):
+            b <<= 1
+        k2 = np.zeros(b, np.int32)
+        s2 = np.zeros(b, np.int32)
+        e2 = np.zeros(b, np.int32)
+        ok = np.zeros(b, bool)
+        # piece 1: [s, wrap ? n : e_raw)
+        k2[:B] = keys
+        s2[:B] = s
+        e2[:B] = np.where(wraps, self.n, e_raw)
+        ok[:B] = ends > starts
+        # piece 2 (wrapping only): [0, e_raw)
+        k2[B:2 * B] = keys
+        s2[B:2 * B] = 0
+        e2[B:2 * B] = np.where(wraps, e_raw, 0)
+        ok[B:2 * B] = wraps
+        out = np.asarray(self._query(self.tree, jnp.asarray(k2),
+                                     jnp.asarray(s2), jnp.asarray(e2),
+                                     jnp.asarray(ok)))
+        head, tail = out[:B], out[B:2 * B]
+        combined = np.asarray(self.combine(jnp.asarray(head),
+                                           jnp.asarray(tail)))
+        return np.where(wraps, combined, head)
+
+
 class FlatFATJax:
     """Stateful host wrapper owning the device tree array.
 
