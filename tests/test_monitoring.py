@@ -10,7 +10,7 @@ import threading
 import pytest
 
 import windflow_tpu as wf
-from windflow_tpu.core import BasicRecord, Mode, RuntimeConfig
+from windflow_tpu.core import BasicRecord, Mode, RuntimeConfig, WinType
 from windflow_tpu.monitoring.stats import GraphStats, StatsRecord
 
 
@@ -117,3 +117,34 @@ def test_dashboard_protocol(tmp_path):
     assert dash.deregistered
     assert dash.reports, "at least one 1 Hz report"
     assert dash.reports[-1]["PipeGraph_name"] == "traced"
+
+
+def test_device_metrics_reported(tmp_path):
+    """Device launches / staged bytes appear in the per-replica stats
+    under tracing (the H2D/D2H counters of stats_record.hpp:77-79)."""
+    import numpy as np
+    from windflow_tpu.core.tuples import TupleBatch
+    from windflow_tpu.operators.batch_ops import BatchSource
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
+
+    cfg = RuntimeConfig(tracing=True, log_dir=str(tmp_path))
+    g = wf.PipeGraph("devstats", Mode.DEFAULT, cfg)
+    n = 20_000
+    keys = np.arange(n, dtype=np.int64) % 4
+    ids = np.arange(n, dtype=np.int64) // 4
+    it = iter([TupleBatch({"key": keys[i:i + 4096], "id": ids[i:i + 4096],
+                           "ts": ids[i:i + 4096],
+                           "value": np.ones(len(keys[i:i + 4096]))})
+               for i in range(0, n, 4096)])
+    op = WinSeqTPU("sum", 128, 64, WinType.TB, batch_len=64,
+                   emit_batches=True)
+    g.add_source(BatchSource(lambda ctx: next(it, None))).add(op) \
+        .add_sink(wf.SinkBuilder(lambda x: None).build())
+    g.run()
+    data = json.loads(g.stats.to_json())
+    win = next(o for o in data["Operators"]
+               if "win_seq_tpu" in o["Operator_name"])
+    rep = win["Replicas"][0]
+    assert rep["Device_launches"] > 0
+    assert rep["Bytes_to_device"] > 0
+    assert rep["Bytes_from_device"] > 0

@@ -111,6 +111,9 @@ class WinSeqFFATResidentLogic(NodeLogic):
         res = self.forest.query(np.asarray(rows), np.asarray(qs),
                                 np.asarray(qe))
         self.launched_batches += 1
+        if self.stats is not None:
+            self.stats.num_launches += 1
+            self.stats.bytes_from_device += res.nbytes
         for (key, lwid), end, val in zip(meta, qe, res):
             out = self.result_factory()
             out.value = float(val)

@@ -302,6 +302,8 @@ class WinSeqTPULogic(NodeLogic):
         import time as _time
         if len(self.latency_samples) < 100_000:
             self.latency_samples.append(_time.perf_counter() - birth)
+        if self.stats is not None:  # single-writer: dispatcher thread
+            self.stats.bytes_from_device += results.nbytes
         self._emit_results(results, descs, emit)
 
     def _submit(self, cols, starts, ends, gwids, descs, birth, emit,
@@ -309,6 +311,12 @@ class WinSeqTPULogic(NodeLogic):
         """Hand one staged batch to the device: via the dispatcher
         thread (default) or inline with the waitAndFlush protocol."""
         eng = engine or self.engine
+        if self.stats is not None:  # single-writer: ingest thread
+            self.stats.num_launches += 1
+            self.stats.bytes_to_device += (
+                sum(int(np.asarray(c).nbytes) for c in cols.values())
+                + starts.nbytes + ends.nbytes + gwids.nbytes)
+            self.stats.inputs_ignored = self.ignored_tuples
         if self.async_dispatch:
             if self._dispatcher is None:
                 self._dispatcher = _AsyncDispatcher(self)

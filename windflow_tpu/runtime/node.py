@@ -31,6 +31,8 @@ class EOSMarker:
 class NodeLogic:
     """Base class for operator replica logic."""
 
+    stats = None  # replica StatsRecord, attached by RtNode under tracing
+
     def svc_init(self) -> None:
         pass
 
@@ -105,6 +107,9 @@ class RtNode(threading.Thread):
 
     def run(self) -> None:
         try:
+            # logics that track device metrics (launches, staged bytes)
+            # write them into the replica's record directly
+            self.logic.stats = self.stats
             self.logic.svc_init()
             if self.channel is not None:
                 stats = self.stats
