@@ -302,3 +302,25 @@ def test_randomized_parallelism_tumbling_default_mode(seed):
         if expect is None:
             expect = 3.0 * sum(range(n))
         assert sink.total == expect
+
+
+def test_merge_validity_checks():
+    """The reference rejects structurally invalid merges
+    (pipegraph.hpp:186-286); mirror its checks."""
+    g = wf.PipeGraph("mv", Mode.DEFAULT)
+    p1 = g.add_source(wf.SourceBuilder(source_fn(5)).build())
+    p2 = g.add_source(wf.SourceBuilder(source_fn(5)).build())
+    with pytest.raises(RuntimeError, match="itself"):
+        p1.merge(p1)
+    g2 = wf.PipeGraph("other", Mode.DEFAULT)
+    q = g2.add_source(wf.SourceBuilder(source_fn(5)).build())
+    with pytest.raises(RuntimeError, match="different PipeGraph"):
+        p1.merge(q)
+    m = p1.merge(p2)
+    p3 = g.add_source(wf.SourceBuilder(source_fn(5)).build())
+    with pytest.raises(RuntimeError, match="already merged"):
+        p3.merge(p1)
+    m.split(lambda t: 0, 2)
+    p4 = g.add_source(wf.SourceBuilder(source_fn(5)).build())
+    with pytest.raises(RuntimeError, match="split"):
+        p4.merge(m)

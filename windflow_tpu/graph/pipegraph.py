@@ -124,7 +124,22 @@ class PipeGraph:
         merge-full/ind/partial distinction collapses here because wiring
         is explicit)."""
         all_pipes = [mp, *others]
+        # validity checks (pipegraph.hpp:186-286 analogues)
+        seen_ids = set()
         for p in all_pipes:
+            if id(p) in seen_ids:
+                raise RuntimeError("cannot merge a MultiPipe with itself")
+            seen_ids.add(id(p))
+            if p.graph is not self:
+                raise RuntimeError(
+                    "cannot merge MultiPipes from different PipeGraphs")
+            if p.merged_into is not None:
+                raise RuntimeError(
+                    f"MultiPipe {p.name} was already merged")
+            if p.children:
+                raise RuntimeError(
+                    f"MultiPipe {p.name} was split; merge its branches "
+                    "(select(i)) instead")
             if p.has_sink:
                 raise RuntimeError("cannot merge a terminated MultiPipe")
             if not p.tails:
