@@ -148,3 +148,21 @@ def test_device_metrics_reported(tmp_path):
     assert rep["Device_launches"] > 0
     assert rep["Bytes_to_device"] > 0
     assert rep["Bytes_from_device"] > 0
+
+
+def test_runtime_queue_stats_dump(tmp_path):
+    """trace_runtime dumps raw channel stats (puts/gets/high-watermark),
+    the -DTRACE_FASTFLOW analogue (pipegraph.hpp:711-733)."""
+    cfg = RuntimeConfig(trace_runtime=True, log_dir=str(tmp_path))
+    g = small_graph(cfg)
+    g.run()
+    f = next(p for p in tmp_path.iterdir() if p.name.endswith("_runtime.json"))
+    data = json.loads(f.read_text())
+    assert data["channels"], "no channel rows dumped"
+    by_node = {r["node"]: r for r in data["channels"]}
+    consumed = [r for r in data["channels"] if r["gets"] > 0]
+    assert consumed, by_node
+    for r in consumed:
+        assert r["puts"] >= r["gets"]
+        assert r["residual"] == 0
+        assert r["high_watermark"] >= 1

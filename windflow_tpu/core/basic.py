@@ -148,6 +148,10 @@ class RuntimeConfig:
 
     mode: Mode = Mode.DEFAULT
     tracing: bool = False
+    # second tracing level: raw channel stats (puts/gets/high-watermark)
+    # dumped at wait_end -- the -DTRACE_FASTFLOW analogue
+    # (pipegraph.hpp:711-733)
+    trace_runtime: bool = False
     bounded_queues: bool = True
     queue_capacity: int = DEFAULT_QUEUE_CAPACITY
     microbatch: int = DEFAULT_MICROBATCH

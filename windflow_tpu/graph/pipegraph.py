@@ -194,9 +194,38 @@ class PipeGraph:
             self._monitor.stop()
         if self.config.tracing:
             self._dump_logs()
+        if self.config.trace_runtime:
+            self._dump_runtime_stats()
         if errors:
             name, err = errors[0]
             raise RuntimeError(f"node {name} failed: {err!r}") from err
+
+    def _dump_runtime_stats(self) -> None:
+        """Raw channel stats per consumer node (the -DTRACE_FASTFLOW
+        queue/thread dump, pipegraph.hpp:711-733).  Counters are
+        best-effort under concurrent producers (tracing-grade)."""
+        import json
+        import os
+        rows = []
+        for n in self._all_nodes():
+            ch = n.channel
+            if ch is None:
+                continue
+            rows.append({
+                "node": n.name,
+                "channel_impl": type(ch).__name__,
+                "capacity": getattr(ch, "capacity", None),
+                "producers": ch.n_producers,
+                "puts": getattr(ch, "puts", 0),
+                "gets": getattr(ch, "gets", 0),
+                "high_watermark": getattr(ch, "high_watermark", 0),
+                "residual": ch.qsize(),
+            })
+        os.makedirs(self.config.log_dir, exist_ok=True)
+        path = os.path.join(self.config.log_dir,
+                            f"{os.getpid()}_{self.name}_runtime.json")
+        with open(path, "w") as f:
+            json.dump({"graph": self.name, "channels": rows}, f, indent=1)
 
     def _dump_logs(self) -> None:
         """Write per-graph stats JSON + graphviz DOT under log_dir

@@ -125,7 +125,8 @@ def native_available() -> bool:
 class NativeChannel:
     """Drop-in for runtime.queues.Channel backed by the C++ channel."""
 
-    __slots__ = ("lib", "ptr", "n_producers")
+    __slots__ = ("lib", "ptr", "n_producers", "capacity", "puts", "gets",
+                 "high_watermark")
 
     def __init__(self, capacity: int = 2048):
         self.lib = get_lib()
@@ -133,6 +134,11 @@ class NativeChannel:
             raise RuntimeError("native runtime unavailable")
         self.ptr = self.lib.wfn_channel_new(capacity)
         self.n_producers = 0
+        self.capacity = capacity
+        # raw queue counters (TRACE_FASTFLOW analogue)
+        self.puts = 0
+        self.gets = 0
+        self.high_watermark = 0
 
     def register_producer(self) -> int:
         self.n_producers += 1
@@ -141,6 +147,10 @@ class NativeChannel:
     def put(self, producer_id: int, item: Any) -> None:
         ctypes.pythonapi.Py_IncRef(ctypes.py_object(item))
         self.lib.wfn_channel_put(self.ptr, producer_id, id(item))
+        self.puts += 1
+        d = self.lib.wfn_channel_size(self.ptr)
+        if d > self.high_watermark:
+            self.high_watermark = d
 
     def close(self, producer_id: int) -> None:
         self.lib.wfn_channel_close(self.ptr, producer_id)
@@ -154,6 +164,7 @@ class NativeChannel:
             return None
         obj = ctypes.cast(handle.value, ctypes.py_object).value
         ctypes.pythonapi.Py_DecRef(ctypes.py_object(obj))
+        self.gets += 1
         return cid.value, obj
 
     def qsize(self) -> int:

@@ -27,13 +27,20 @@ class Channel:
     registered producer has closed (the FastFlow EOS-propagation analogue).
     """
 
-    __slots__ = ("q", "n_producers", "_eos_seen", "_lock")
+    __slots__ = ("q", "n_producers", "_eos_seen", "_lock", "capacity",
+                 "puts", "gets", "high_watermark")
 
     def __init__(self, capacity: int = DEFAULT_QUEUE_CAPACITY):
         self.q: _queue.Queue = _queue.Queue(maxsize=capacity)
         self.n_producers = 0
         self._eos_seen = 0
         self._lock = threading.Lock()
+        self.capacity = capacity
+        # raw queue counters (TRACE_FASTFLOW analogue); puts/hwm written
+        # under the producer's put, gets by the single consumer
+        self.puts = 0
+        self.gets = 0
+        self.high_watermark = 0
 
     def register_producer(self) -> int:
         with self._lock:
@@ -43,6 +50,10 @@ class Channel:
 
     def put(self, producer_id: int, item: Any) -> None:
         self.q.put((producer_id, item))
+        self.puts += 1
+        d = self.q.qsize()
+        if d > self.high_watermark:
+            self.high_watermark = d
 
     def close(self, producer_id: int) -> None:
         self.q.put((producer_id, _EOS_SENTINEL))
@@ -56,6 +67,7 @@ class Channel:
                 if self._eos_seen >= self.n_producers:
                     return None
                 continue
+            self.gets += 1
             return pid, item
 
     def qsize(self) -> int:
