@@ -69,7 +69,10 @@ def run_tpu_graph(n_events, warmup=False):
         ridx = ctx.get_replica_index()
         st = state.setdefault(ridx, {
             "sent": 0,
-            "pool": np.random.default_rng(ridx).random(SOURCE_BATCH)})
+            # f32 pool: the native engine ingests float32 without a
+            # widening copy (values widen on the scatter write)
+            "pool": np.random.default_rng(ridx).random(
+                SOURCE_BATCH).astype(np.float32)})
         i = st["sent"]
         share = n_events // SOURCE_PARALLELISM
         if i >= share:

@@ -171,8 +171,11 @@ struct Engine {
         return tab_dense[h];
     }
 
+    // TV = double or float: f32 sources ingest without a host-side
+    // widening copy (values widen per element at the scatter write)
+    template <typename TV>
     void ingest_batch(const i64* bkeys, const i64* ids, const i64* tss,
-                      const double* vals, i64 n) {
+                      const TV* vals, i64 n) {
         ++call_id;
         d_key.clear();
         d_state.clear();
@@ -621,6 +624,14 @@ void wfn_engine_free(void* e) { delete static_cast<Engine*>(e); }
 // number of ready (fired, unstaged) windows afterwards.
 i64 wfn_engine_ingest(void* ep, const i64* keys, const i64* ids,
                       const i64* tss, const double* vals, i64 n) {
+    Engine& e = *static_cast<Engine*>(ep);
+    e.ingest_batch(keys, ids, tss, vals, n);
+    return (i64)e.ready.size();
+}
+
+// f32 value column variant (no widening copy on the host side).
+i64 wfn_engine_ingest_f32(void* ep, const i64* keys, const i64* ids,
+                          const i64* tss, const float* vals, i64 n) {
     Engine& e = *static_cast<Engine*>(ep);
     e.ingest_batch(keys, ids, tss, vals, n);
     return (i64)e.ready.size();

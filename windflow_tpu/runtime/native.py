@@ -100,6 +100,10 @@ def get_lib():
         lib.wfn_engine_ingest.restype = LL
         lib.wfn_engine_ingest.argtypes = [ctypes.c_void_p, PLL, PLL, PLL,
                                           PD, LL]
+        lib.wfn_engine_ingest_f32.restype = LL
+        lib.wfn_engine_ingest_f32.argtypes = [
+            ctypes.c_void_p, PLL, PLL, PLL,
+            ctypes.POINTER(ctypes.c_float), LL]
         lib.wfn_engine_ready.restype = LL
         lib.wfn_engine_ready.argtypes = [ctypes.c_void_p]
         lib.wfn_engine_eos.argtypes = [ctypes.c_void_p]
@@ -235,8 +239,18 @@ class NativeWindowEngine:
         keys = np.ascontiguousarray(keys, np.int64)
         ids = np.ascontiguousarray(ids, np.int64)
         ts = np.ascontiguousarray(ts, np.int64)
-        vals = np.ascontiguousarray(vals, np.float64)
         LL = ctypes.c_longlong
+        vals = np.asarray(vals)
+        if vals.dtype == np.float32 and vals.flags.c_contiguous:
+            # f32 lane: no widening copy; the engine widens per element
+            return self.lib.wfn_engine_ingest_f32(
+                self.ptr,
+                keys.ctypes.data_as(ctypes.POINTER(LL)),
+                ids.ctypes.data_as(ctypes.POINTER(LL)),
+                ts.ctypes.data_as(ctypes.POINTER(LL)),
+                vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                len(keys))
+        vals = np.ascontiguousarray(vals, np.float64)
         return self.lib.wfn_engine_ingest(
             self.ptr,
             keys.ctypes.data_as(ctypes.POINTER(LL)),
