@@ -195,3 +195,72 @@ def test_native_snapshot_rejects_mismatched_config():
     e3.deserialize(blob)  # matching config restores fine
     with pytest.raises(ValueError):
         e3.deserialize(blob[:20])  # truncated blob rejected
+
+
+def test_run_with_recovery_restarts_on_node_failure(tmp_path):
+    """A graph whose sink fails on the first attempt recovers: the
+    factory is rebuilt, prior accumulator state restored, and the
+    retry completes (SURVEY.md §5: the recovery layer the reference
+    lacks)."""
+    from windflow_tpu.utils.checkpoint import run_with_recovery
+
+    ckpt = str(tmp_path / "state.pkl")
+    seen = {"totals": []}
+
+    def factory(attempt):
+        collected = []
+
+        def src(shipper, ctx):
+            i = getattr(src, "i", 0)
+            if i >= 50:
+                return False
+            shipper.push(BasicRecord(i % 2, i // 2, i, float(i)))
+            src.i = i + 1
+            return True
+        src.i = 0
+
+        def acc(t, result):
+            result.value += t.value
+
+        def snk(rec):
+            if rec is None:
+                return
+            if attempt == 0 and rec.value > 100:
+                raise RuntimeError("injected sink failure")
+            collected.append(rec.value)
+
+        g = wf.PipeGraph(f"rec", wf.Mode.DEFAULT)
+        g.add_source(wf.SourceBuilder(src).build()) \
+            .add(wf.AccumulatorBuilder(acc).build()) \
+            .add_sink(wf.SinkBuilder(snk).build())
+        seen["totals"].append(collected)
+        return g
+
+    g = run_with_recovery(factory, ckpt, max_restarts=2)
+    assert g is not None
+    # the second attempt completed (max per-key rolling sum present)
+    final = seen["totals"][-1]
+    assert max(final) == sum(v for v in range(50) if v % 2 == 0) or \
+        max(final) == sum(v for v in range(50) if v % 2 == 1)
+
+    # exhausting restarts re-raises
+    def failing_factory(attempt):
+        def src(shipper, ctx):
+            i = getattr(src, "i", 0)
+            if i >= 3:
+                return False
+            shipper.push(BasicRecord(0, i, i, 1.0))
+            src.i = i + 1
+            return True
+        src.i = 0
+
+        def snk(rec):
+            if rec is not None:
+                raise RuntimeError("permanent failure")
+        g = wf.PipeGraph("bad2", wf.Mode.DEFAULT)
+        g.add_source(wf.SourceBuilder(src).build()) \
+            .add_sink(wf.SinkBuilder(snk).build())
+        return g
+    with pytest.raises(RuntimeError):
+        run_with_recovery(failing_factory, str(tmp_path / "s2.pkl"),
+                          max_restarts=1)

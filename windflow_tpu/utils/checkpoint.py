@@ -53,3 +53,41 @@ def restore_graph(graph, path: str) -> int:
             loader(st)
             n += 1
     return n
+
+
+def run_with_recovery(graph_factory, checkpoint_path: str,
+                      max_restarts: int = 3) -> Any:
+    """Failure-recovery policy runner (the recovery layer the reference
+    lacks entirely, SURVEY.md §5 "failure detection / elastic
+    recovery: Absent").
+
+    ``graph_factory(attempt: int) -> PipeGraph`` builds a structurally
+    identical graph each attempt (fresh sources may resume from their
+    own offsets via the attempt number).  The graph runs to completion;
+    on a node failure (RuntimeError from ``wait_end`` with node
+    attribution) the latest checkpoint -- taken after every successful
+    run()-quiescent state, or seeded by the caller -- is restored into a
+    freshly built graph and the run retries, up to ``max_restarts``.
+
+    Checkpoints are only taken at quiescent points (this runner
+    checkpoints AFTER a successful run; mid-stream snapshots require
+    the caller to stage input so a replayed attempt re-feeds unacked
+    data -- at-least-once semantics, like any checkpoint/replay system
+    without source acknowledgement).
+
+    Returns the graph whose run completed.
+    """
+    import os
+    attempt = 0
+    while True:
+        g = graph_factory(attempt)
+        if attempt > 0 and os.path.exists(checkpoint_path):
+            restore_graph(g, checkpoint_path)
+        try:
+            g.run()
+            save_graph(g, checkpoint_path)
+            return g
+        except RuntimeError:
+            attempt += 1
+            if attempt > max_restarts:
+                raise
