@@ -198,6 +198,33 @@ class ShardedWindowEngine:
         sh = NamedSharding(self.mesh, P("key", "win", None))
         return self._ring(jax.device_put(pane_values, sh))
 
+    def compute_wmr(self, stripes):
+        """Striped window sums with a psum over 'win' (the Win_MapReduce
+        distribution as a standalone program, used by
+        operators.tpu.mesh_farm.WinMapReduceMesh).
+
+        ``stripes``: [K_rows, W_shards, B, stripe_len] — window b of row
+        k holds its tuples round-robin striped over the 'win' axis
+        (WinMap_Emitter's per-key round robin, wm_nodes.hpp:62).
+        Returns [K_rows, B] full window sums."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if not hasattr(self, "_wmr_only"):
+            import jax.numpy as jnp
+
+            def wmr_shard(stripe):
+                partial = jnp.sum(stripe, axis=(-1,))
+                return jax.lax.psum(partial, "win")
+
+            self._wmr_only = jax.jit(jax.shard_map(
+                wmr_shard, mesh=self.mesh,
+                in_specs=(P("key", "win", None, None),),
+                out_specs=P("key", None, None), check_vma=False))
+        sh = NamedSharding(self.mesh, P("key", "win", None, None))
+        out = self._wmr_only(jax.device_put(stripes, sh))
+        return out[:, 0, :]
+
     def compute_kf(self, values, starts, ends):
         """Key-sharded window sums only (the Key_Farm-across-chips path
         used by operators.tpu.mesh_farm).  ``values`` is [K_shards, T],
