@@ -426,25 +426,36 @@ struct Engine {
             auto [off, base_key] = base[ds.key];
             st_keys.push_back(ds.key);
             st_gwids.push_back(ds.lwid);
-            st_starts.push_back(off + (ds.start - base_key) / pane);
-            st_ends.push_back(off + (ds.end - base_key) / pane);
+            // tuple extent of the window: a window with zero tuples in
+            // a gapped id space must stage an EMPTY pane range
+            // (start==end) so the device combine emits the masked
+            // neutral 0, exactly like the Python/XLA path
+            // (window_compute.py's `jnp.where(valid, out, 0)`) --
+            // otherwise max/min kinds would emit the +-inf pane fill
+            KeyState& st = keys[ds.key];
+            i64 lo, hi;
+            if (st.dense) {
+                lo = st.pos_of(ds.start);
+                hi = st.pos_of(ds.end);
+            } else {
+                auto a = std::lower_bound(st.ids.begin(), st.ids.end(),
+                                          ds.start);
+                auto b = std::lower_bound(a, st.ids.end(), ds.end);
+                lo = a - st.ids.begin();
+                hi = b - st.ids.begin();
+            }
+            if (hi > lo) {
+                st_starts.push_back(off + (ds.start - base_key) / pane);
+                st_ends.push_back(off + (ds.end - base_key) / pane);
+            } else {
+                st_starts.push_back(off);
+                st_ends.push_back(off);
+            }
             if (is_tb) {
                 st_rts.push_back(ds.lwid * slide + win - 1);
             } else {
                 // CB: result timestamp = ts of the last tuple in the
                 // window extent (matches the host engine / reference)
-                KeyState& st = keys[ds.key];
-                i64 lo, hi;
-                if (st.dense) {
-                    lo = st.pos_of(ds.start);
-                    hi = st.pos_of(ds.end);
-                } else {
-                    auto a = std::lower_bound(st.ids.begin(), st.ids.end(),
-                                              ds.start);
-                    auto b = std::lower_bound(a, st.ids.end(), ds.end);
-                    lo = a - st.ids.begin();
-                    hi = b - st.ids.begin();
-                }
                 st_rts.push_back(hi > lo ? st.ts[hi - 1] : 0);
             }
         }
@@ -530,7 +541,9 @@ struct Engine {
                         std::vector<T>& v) {
         i64 n;
         if (!get(p, end, n) || n < 0) return false;
-        if (p + n * (i64)sizeof(T) > end) return false;
+        // division-based check: p + n*sizeof(T) would overflow for a
+        // corrupted length field (blob comes from on-disk files)
+        if (n > (end - p) / (i64)sizeof(T)) return false;
         v.resize(n);
         std::memcpy(v.data(), p, n * sizeof(T));
         p += n * sizeof(T);

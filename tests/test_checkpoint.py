@@ -264,3 +264,29 @@ def test_run_with_recovery_restarts_on_node_failure(tmp_path):
     with pytest.raises(RuntimeError):
         run_with_recovery(failing_factory, str(tmp_path / "s2.pkl"),
                           max_restarts=1)
+
+
+def test_run_with_recovery_reraises_validation_errors(tmp_path):
+    """Deterministic non-failure RuntimeErrors (e.g. re-running an
+    already-started graph) must propagate immediately, not burn
+    max_restarts re-running the source stream."""
+    from windflow_tpu.utils.checkpoint import run_with_recovery
+
+    calls = {"n": 0}
+
+    def factory(attempt):
+        calls["n"] += 1
+        g = wf.PipeGraph("val", wf.Mode.DEFAULT)
+
+        def src(shipper, ctx):
+            return False
+
+        g.add_source(wf.SourceBuilder(src).build()) \
+            .add_sink(wf.SinkBuilder(lambda r: None).build())
+        g.run()  # already completed: the runner's g.run() must raise
+        return g
+
+    with pytest.raises(RuntimeError, match="already started"):
+        run_with_recovery(factory, str(tmp_path / "c.pkl"),
+                          max_restarts=3)
+    assert calls["n"] == 1  # no retries for a validation error

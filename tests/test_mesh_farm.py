@@ -80,6 +80,7 @@ def test_mesh_farm_uses_all_shards(mesh):
     (4, 96, 8, 800),    # multi-hop ring (wpp=12 > p_loc at W=4)
     (2, 12, 8, 500),    # coprime wpp=3 / spp=2
     (2, 16, 16, 300),   # tumbling
+    (2, 8, 16, 300),    # sampling (slide > win): inter-window gaps
 ])
 def test_pane_farm_mesh_matches_oracle(win_axis, win, slide, per_key):
     """PaneFarmMesh (ring ppermute pane combine as a graph operator) vs
@@ -139,3 +140,72 @@ def test_pane_farm_mesh_matches_oracle(win_axis, win, slide, per_key):
             w += 1
         total_windows = w
     assert missing == 0 and bad == 0, (missing, bad, len(got))
+
+
+@pytest.mark.parametrize("win,slide,OFFSET", [
+    (32, 8, 10_000_000_003),   # sliding
+    (8, 16, 10_000_000_011),   # sampling, first id inside a gap pane
+])
+def test_pane_farm_mesh_large_first_timestamp_anchors(win, slide, OFFSET):
+    """A first tuple with an epoch-scale timestamp must anchor the pane
+    timeline at its first containing window, not pane 0 (which would
+    materialize ~1e9 empty panes and hang); with sampling windows
+    (slide > win) the anchor must never land past the first pane."""
+    from windflow_tpu.operators.tpu.pane_mesh import PaneFarmMesh
+
+    mesh2 = make_mesh(8, win_axis=2)
+    per_key, n_keys = 300, 2
+    vals_per_key = {k: np.random.default_rng(k).random(per_key)
+                    for k in range(n_keys)}
+    state = {"sent": 0}
+
+    def source(ctx):
+        i = state["sent"]
+        total = n_keys * per_key
+        if i >= total:
+            return None
+        n = min(256, total - i)
+        idx = i + np.arange(n)
+        keys = idx % n_keys
+        ids = OFFSET + idx // n_keys
+        vals = np.empty(n)
+        for k in range(n_keys):
+            m = keys == k
+            vals[m] = vals_per_key[k][(ids[m] - OFFSET)]
+        state["sent"] = i + n
+        return TupleBatch({"key": keys, "id": ids, "ts": ids,
+                           "value": vals})
+
+    got = {}
+    lock = threading.Lock()
+
+    def sink(item):
+        if item is None:
+            return
+        with lock:
+            for j in range(len(item)):
+                kk = (int(item.key[j]), int(item.id[j]))
+                assert kk not in got, f"duplicate window {kk}"
+                got[kk] = float(item["value"][j])
+
+    g = wf.PipeGraph("pmesh-anchor", Mode.DEFAULT)
+    op = PaneFarmMesh(mesh2, win, slide, WinType.TB, panes_per_epoch=16)
+    g.add_source(BatchSource(source)).add(op).add_sink(Sink(sink))
+    g.run()
+    assert got, "no windows emitted"
+    # every emitted window matches the ground truth over real tuples
+    bad = 0
+    for (k, w), gv in got.items():
+        lo, hi = w * slide, w * slide + win
+        a = max(0, lo - OFFSET)
+        b = max(0, min(per_key, hi - OFFSET))
+        want = float(vals_per_key[k][a:b].sum()) if b > a else 0.0
+        if abs(gv - want) > 1e-3 * max(1, abs(want)):
+            bad += 1
+    assert bad == 0, (bad, len(got))
+    # and the windows fully inside the stream are all present
+    for k in range(n_keys):
+        w = -(-OFFSET // slide)  # first window starting at/after OFFSET
+        while w * slide + win <= OFFSET + per_key:
+            assert (k, w) in got, (k, w)
+            w += 1

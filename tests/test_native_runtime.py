@@ -177,3 +177,70 @@ def test_engine_partial_flush_keeps_queued_window_data():
             assert vals[starts[i]:ends[i]].sum() == 4.0, int(gwids[i])
             seen += 1
     assert seen == 48
+
+
+def test_engine_gapped_window_stages_empty_extent():
+    """A fired window whose extent contains no tuples (gapped id space)
+    must stage start==end so the device combine emits the masked
+    neutral 0 -- matching the Python/XLA path -- instead of the
+    +-inf pane fill (window_engine.cpp flush staging)."""
+    from windflow_tpu.runtime.native import NativeWindowEngine
+
+    for kind in ("max", "min", "sum"):
+        eng = NativeWindowEngine(4, 4, False, 0, kind=kind)
+        # key 0: ids 0..3 (window 0 full), then a gap to ids 12..15
+        # (window 3 full); windows 1 and 2 have no tuples in extent
+        ids = np.array([0, 1, 2, 3, 12, 13, 14, 15], np.int64)
+        eng.ingest(np.zeros(8, np.int64), ids, ids,
+                   np.full(8, 7.0))
+        eng.eos()
+        got = {}
+        while True:
+            out = eng.flush(1000)
+            if out is None:
+                break
+            vals, starts, ends, d_keys, gwids, _rts = out
+            for i in range(len(gwids)):
+                w = int(gwids[i])
+                seg = vals[starts[i]:ends[i]]
+                if len(seg) == 0:
+                    got[w] = 0.0  # empty extent -> masked neutral
+                elif kind == "max":
+                    got[w] = seg.max()
+                elif kind == "min":
+                    got[w] = seg.min()
+                else:
+                    got[w] = seg.sum()
+        assert got[1] == 0.0 and got[2] == 0.0, (kind, got)
+        assert np.isfinite(list(got.values())).all(), (kind, got)
+        full = 7.0 if kind in ("max", "min") else 28.0
+        assert got[0] == full and got[3] == full, (kind, got)
+
+
+def test_engine_deserialize_rejects_huge_length_field():
+    """A corrupted checkpoint blob with an enormous vector-length field
+    must fail cleanly, not overflow the bounds check into a multi-GB
+    resize (window_engine.cpp get_vec)."""
+    from windflow_tpu.runtime.native import NativeWindowEngine
+
+    e1 = NativeWindowEngine(32, 16, True)
+    e1.ingest(np.zeros(10, np.int64), np.arange(10, dtype=np.int64),
+              np.arange(10, dtype=np.int64), np.ones(10))
+    blob = bytearray(e1.serialize())
+    # the first vector-length field (st.ids) sits after the fixed
+    # header: magic,win,slide,delay,tb,rn,kind,nkeys + per-key
+    # key,next_fire,opened_max,max_id,flags,dense_base = 14 i64s
+    off = 14 * 8
+    import struct
+    # the dense lane may serialize empty ids/ts vectors; walk to the
+    # first non-empty vector length field and corrupt that one
+    for _ in range(3):
+        n = struct.unpack_from("<q", blob, off)[0]
+        if n > 0:
+            break
+        off += 8 + n * 8
+    assert n == 10  # layout check: we found the right field
+    struct.pack_into("<q", blob, off, 1 << 61)
+    e2 = NativeWindowEngine(32, 16, True)
+    with pytest.raises(ValueError):
+        e2.deserialize(bytes(blob))

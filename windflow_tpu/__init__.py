@@ -34,6 +34,7 @@ def __getattr__(name):
     from importlib import import_module
     lazy = {
         "PipeGraph": "windflow_tpu.graph.pipegraph",
+        "NodeFailureError": "windflow_tpu.graph.pipegraph",
         "MultiPipe": "windflow_tpu.graph.multipipe",
     }
     builder_names = (

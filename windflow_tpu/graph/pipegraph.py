@@ -17,6 +17,13 @@ from ..runtime.node import RtNode
 from .multipipe import MultiPipe
 
 
+class NodeFailureError(RuntimeError):
+    """A replica thread died at runtime (vs. graph-validation errors,
+    which raise plain RuntimeError/ValueError and are not recoverable
+    by restarting -- utils/checkpoint.run_with_recovery retries only
+    this type)."""
+
+
 class _AppNode:
     """Application-tree node (pipegraph.hpp:67-79)."""
 
@@ -198,7 +205,8 @@ class PipeGraph:
             self._dump_runtime_stats()
         if errors:
             name, err = errors[0]
-            raise RuntimeError(f"node {name} failed: {err!r}") from err
+            raise NodeFailureError(
+                f"node {name} failed: {err!r}") from err
 
     def _dump_runtime_stats(self) -> None:
         """Raw channel stats per consumer node (the -DTRACE_FASTFLOW

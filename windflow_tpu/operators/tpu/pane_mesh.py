@@ -69,11 +69,28 @@ class PaneFarmMeshLogic(NodeLogic):
         self.keys: Dict[Any, _PaneKeyState] = {}
         self.launched_batches = 0
 
+    # upper bound on panes materialized for one id/ts gap: beyond this
+    # the stream is outside the dense-id contract (e.g. epoch-millis
+    # timestamps with a mis-sized pane) and filling would OOM
+    MAX_GAP_PANES = 1 << 20
+
     # -- host PLQ: pane pre-reduction ---------------------------------
     def _ingest_key(self, key, ids, vals) -> None:
         st = self.keys.get(key)
         if st is None:
             st = self.keys[key] = _PaneKeyState()
+            # anchor the pane timeline at the first window containing
+            # the first tuple (not pane 0): a large first id/ts (e.g.
+            # epoch-millis TB streams) must not materialize ~1e9 empty
+            # panes from an implicit 0 anchor
+            first = int(ids[0]) // self.pane
+            # first window whose extent can contain pane `first`, but
+            # never anchored past it: sampling windows (spp > wpp)
+            # leave inter-window gap panes, and pane_base must stay
+            # <= first so ingest's gap accounting holds
+            w0 = max(0, (first - self.wpp) // self.spp + 1)
+            st.pane_base = min(w0, first // self.spp) * self.spp
+            st.partial_pane = st.pane_base
         # pane index per tuple; ids must be non-decreasing per key
         p = ids // self.pane
         st.max_id = max(st.max_id, int(ids[-1]))
@@ -82,10 +99,16 @@ class PaneFarmMeshLogic(NodeLogic):
             cur = int(p[lo])
             hi = int(np.searchsorted(p, cur + 1, "left"))
             if cur > st.partial_pane:
+                gap = cur - st.partial_pane - 1
+                if gap > self.MAX_GAP_PANES:
+                    raise ValueError(
+                        f"PaneFarmMesh: id/ts gap of {gap} empty panes "
+                        f"for key {key!r} exceeds MAX_GAP_PANES "
+                        f"({self.MAX_GAP_PANES}); stream violates the "
+                        "dense-id scope (check pane/window sizing)")
                 # panes up to cur-1 are complete
                 st.panes.append(st.partial)
-                for _ in range(st.partial_pane + 1, cur):
-                    st.panes.append(0.0)  # empty panes
+                st.panes.extend([0.0] * gap)  # empty panes
                 st.partial = 0.0
                 st.partial_pane = cur
             st.partial += float(vals[lo:hi].sum())

@@ -18,6 +18,8 @@ from __future__ import annotations
 import pickle
 from typing import Any, Dict, List
 
+from ..graph.pipegraph import NodeFailureError
+
 
 def graph_state(graph) -> Dict[str, Any]:
     """Collect every replica's state_dict, keyed by node name."""
@@ -64,8 +66,9 @@ def run_with_recovery(graph_factory, checkpoint_path: str,
     ``graph_factory(attempt: int) -> PipeGraph`` builds a structurally
     identical graph each attempt (fresh sources may resume from their
     own offsets via the attempt number).  The graph runs to completion;
-    on a node failure (RuntimeError from ``wait_end`` with node
-    attribution) the latest checkpoint -- taken after every successful
+    on a node failure (``NodeFailureError`` from ``wait_end`` -- a
+    replica thread died; deterministic validation errors raise plain
+    RuntimeError and propagate immediately) the latest checkpoint -- taken after every successful
     run()-quiescent state, or seeded by the caller -- is restored into a
     freshly built graph and the run retries, up to ``max_restarts``.
 
@@ -87,7 +90,11 @@ def run_with_recovery(graph_factory, checkpoint_path: str,
             g.run()
             save_graph(g, checkpoint_path)
             return g
-        except RuntimeError:
+        except NodeFailureError:
+            # only replica-thread deaths are retried; deterministic
+            # graph-construction/validation errors (plain RuntimeError
+            # from merge checks etc.) re-raise immediately instead of
+            # silently re-running the full source stream
             attempt += 1
             if attempt > max_restarts:
                 raise
