@@ -3,14 +3,21 @@
 
 BASELINE.json metric: "tuples/sec/chip on keyed sliding-window
 aggregate".  The workload is config #2 (keyed sliding time-window sum on
-a synthetic source) on the columnar plane: BatchSource -> KeyFarmTPU
+a synthetic source) on the columnar plane: BatchSource -> WinSeqTPU
 (device-batched window sums, async double-buffered) -> counting sink.
 
-The reference publishes no numbers (BASELINE.md), so ``vs_baseline``
-compares against the in-process reference-style engine: the same
-workload run through the record-at-a-time host Win_Seq path (the
-reference's CPU architecture re-created here), i.e. device-batched
-columnar plane vs FastFlow-style scalar plane on the same machine.
+Baseline honesty (VERDICT r1 #2): the reference itself cannot be built
+on this box -- its CPU suite requires FastFlow, which CMake clones from
+github at configure time (/root/reference/CMakeLists.txt:30-37) and
+this environment has no network egress.  The measured stand-in is the
+native C++ record-at-a-time pipeline in reference architecture (one
+thread per operator stage over SPSC rings -- the FastFlow design,
+SURVEY.md L0) running the identical workload: native/record_pipeline.cpp
+mode="threaded".  ``vs_baseline`` = columnar TPU plane vs that number.
+
+The emitted JSON carries the backend that actually ran ("tpu" or
+"cpu-fallback") -- a fallback is flagged IN the JSON, not only stderr
+(VERDICT r1 weak #1).
 
 Prints exactly one JSON line on stdout.
 """
@@ -24,16 +31,28 @@ import time
 import numpy as np
 
 
-def _probe_tpu(timeout_s: int = 150) -> bool:
+def _probe_tpu(timeout_s: int = 240, attempts: int = 2) -> bool:
     """Check device reachability in a subprocess: a wedged PJRT tunnel
-    hangs jax.devices() forever and would otherwise wedge the bench."""
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, capture_output=True)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    hangs jax.devices() forever and would otherwise wedge the bench.
+    Each attempt uses a fresh interpreter (fresh PJRT client), so a
+    transient transport failure gets a clean retry."""
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; jax.devices(); "
+                 "import jax.numpy as jnp; "
+                 "(jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()"],
+                timeout=timeout_s, capture_output=True)
+            if r.returncode == 0:
+                return True
+            print(f"[bench] probe attempt {i + 1}: rc={r.returncode} "
+                  f"{r.stderr.decode()[-200:]}", file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"[bench] probe attempt {i + 1}: timeout after "
+                  f"{timeout_s}s", file=sys.stderr)
+    return False
+
 
 N_EVENTS = 64_000_000
 SOURCE_PARALLELISM = 1
@@ -44,7 +63,7 @@ SOURCE_BATCH = 1_048_576
 DEVICE_BATCH = 16_384
 MAX_BUFFER = 1 << 21
 INFLIGHT = 8
-HOST_BASELINE_EVENTS = 400_000
+BASELINE_EVENTS = 32_000_000
 
 
 def run_tpu_graph(n_events, warmup=False):
@@ -119,49 +138,48 @@ def run_tpu_graph(n_events, warmup=False):
     return n_events / dt, got["windows"], dt, lat
 
 
-def run_host_baseline(n_events):
-    """Reference-architecture path: record-at-a-time host Win_Seq with
-    incremental update (the CPU engine every reference operator uses)."""
-    import windflow_tpu as wf
-    from windflow_tpu.core import BasicRecord
-
-    state = {"sent": 0}
-
-    def source(shipper, ctx):
-        i = state["sent"]
-        if i >= n_events:
-            return False
-        shipper.push(BasicRecord(i % N_KEYS, i // N_KEYS, i // N_KEYS,
-                                 float(i % 97)))
-        state["sent"] = i + 1
-        return True
-
-    count = {"n": 0}
-
-    def sink(rec):
-        if rec is not None:
-            count["n"] += 1
-
-    def upd(gwid, t, result):
-        result.value += t.value
-
-    g = wf.PipeGraph("baseline", wf.Mode.DEFAULT)
-    op = wf.KeyFarmBuilder(upd).with_incremental() \
-        .with_tb_windows(WIN, SLIDE).with_parallelism(1).build()
-    g.add_source(wf.SourceBuilder(source).build()) \
-        .add(op).add_sink(wf.SinkBuilder(sink).build())
+def run_reference_arch_baseline(n_events):
+    """The honest baseline: identical workload through the native C++
+    record-at-a-time engine in the reference's architecture (one thread
+    per operator stage, SPSC rings, FastFlow-style -- see module
+    docstring for why the reference itself cannot be built here)."""
+    from windflow_tpu.runtime.native import (NativeRecordPipeline,
+                                             native_available)
+    if not native_available():
+        return None
+    rp = NativeRecordPipeline("threaded", 1)
+    rp.add_window(WIN, SLIDE, True, "sum")
+    rp.set_synth(n_events, N_KEYS, 97)
     t0 = time.perf_counter()
-    g.run()
-    dt = time.perf_counter() - t0
-    return n_events / dt
+    rp.start()
+    rp.wait()
+    return n_events / (time.perf_counter() - t0)
+
+
+def run_fused_host(n_events):
+    """The framework's fast host path for the same workload: the fused
+    native chain (what graph lowering runs for declared pipelines)."""
+    from windflow_tpu.runtime.native import (NativeRecordPipeline,
+                                             native_available)
+    if not native_available():
+        return None
+    rp = NativeRecordPipeline("fused", 1)
+    rp.add_window(WIN, SLIDE, True, "sum")
+    rp.set_synth(n_events, N_KEYS, 97)
+    t0 = time.perf_counter()
+    rp.start()
+    rp.wait()
+    return n_events / (time.perf_counter() - t0)
 
 
 def main():
+    backend = "tpu"
     if not _probe_tpu():
-        # device unreachable: fall back to the host XLA backend so the
-        # bench still reports (flagged in the metric note on stderr)
+        # device unreachable after retries: fall back to the host XLA
+        # backend so the bench still reports -- flagged in the JSON
         print("[bench] WARNING: TPU backend unreachable; using CPU "
               "backend", file=sys.stderr)
+        backend = "cpu-fallback"
         import jax
         jax.config.update("jax_platforms", "cpu")
     # warmup: populate jit caches with the shapes the timed run uses --
@@ -178,18 +196,30 @@ def main():
                             np.arange(b_pad, dtype=np.int64))
     h.block()
     rate, windows, dt, lat = run_tpu_graph(N_EVENTS)
-    host_rate = run_host_baseline(HOST_BASELINE_EVENTS)
+    base_rate = run_reference_arch_baseline(BASELINE_EVENTS)
+    fused_rate = run_fused_host(BASELINE_EVENTS)
     p99 = np.percentile(lat, 99) * 1e3 if lat else float("nan")
-    print(f"[bench] tpu: {rate:,.0f} tuples/s ({windows} windows in "
-          f"{dt:.2f}s, p99 batch latency {p99:.1f} ms); "
-          f"host reference-style: {host_rate:,.0f} tuples/s",
-          file=sys.stderr)
-    print(json.dumps({
+    print(f"[bench] {backend}: {rate:,.0f} tuples/s ({windows} windows "
+          f"in {dt:.2f}s, p99 batch latency {p99:.1f} ms); "
+          f"reference-arch C++ baseline: "
+          f"{base_rate:,.0f} tuples/s; fused host path: "
+          f"{fused_rate:,.0f} tuples/s", file=sys.stderr)
+    out = {
         "metric": "keyed sliding-window aggregate throughput",
         "value": round(rate, 1),
         "unit": "tuples/sec/chip",
-        "vs_baseline": round(rate / host_rate, 2),
-    }))
+        "vs_baseline": (round(rate / base_rate, 2)
+                        if base_rate else None),
+        "backend": backend,
+        "baseline_arch": "native C++ thread-per-stage record plane "
+                         "(FastFlow-style; reference unbuildable "
+                         "offline, see BASELINE.md)",
+        "baseline_rate": round(base_rate, 1) if base_rate else None,
+        "host_fused_rate": round(fused_rate, 1) if fused_rate else None,
+        "p99_batch_latency_ms": (round(float(p99), 2)
+                                 if np.isfinite(p99) else None),
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,622 @@
+// Native record-at-a-time pipeline engine.
+//
+// Two roles (VERDICT round 1, items #2/#3):
+//
+// 1. **Reference-architecture baseline** (mode=threaded): one OS thread
+//    per operator stage connected by SPSC rings of 32-byte records --
+//    the FastFlow design the reference runs on (SURVEY.md L0:
+//    "threads pinned to cores, lock-free SPSC queues"; hot loop
+//    win_seq.hpp:319-511).  The reference itself cannot be built here
+//    (FastFlow is cloned at cmake time, CMakeLists.txt:30-37, and this
+//    box has no network), so this engine IS the measured stand-in:
+//    same architecture, same record granularity, C++ speed.
+//
+// 2. **Fast host path** (mode=fused): the whole chain fused into one
+//    loop per key-shard (the reference's chain_operator thread-fusion,
+//    multipipe.hpp:345-390, applied end-to-end), S shards giving
+//    Key_Farm-style multicore scaling.
+//
+// Stages cover the BASELINE config-#1 pipeline (map -> filter ->
+// keyed window aggregate -> sink) with expression descriptors; window
+// semantics match native/window_engine.cpp (windows fire in wid order;
+// a window with no tuples in extent emits the masked neutral 0).
+//
+// Exposed via plain C ABI for ctypes (windflow_tpu/runtime/native.py).
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <limits>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using i64 = long long;
+
+struct Rec {
+    i64 key, id, ts;
+    double value;
+};
+
+// ---------------------------------------------------------------- SPSC ring
+// Single-producer single-consumer bounded ring of records (the
+// FastFlow uSPSC-queue analogue).  Spin-then-yield on full/empty.
+struct Ring {
+    explicit Ring(std::size_t cap_pow2) {
+        std::size_t c = 1;
+        while (c < cap_pow2) c <<= 1;
+        buf.resize(c);
+        mask = c - 1;
+    }
+    std::vector<Rec> buf;
+    std::size_t mask;
+    alignas(64) std::atomic<std::uint64_t> head{0};  // consumer
+    alignas(64) std::atomic<std::uint64_t> tail{0};  // producer
+    alignas(64) std::atomic<bool> closed{false};
+
+    inline void push(const Rec& r) {
+        std::uint64_t t = tail.load(std::memory_order_relaxed);
+        int spins = 0;
+        while (t - head.load(std::memory_order_acquire) > mask) {
+            if (++spins > 1024) { std::this_thread::yield(); spins = 0; }
+        }
+        buf[t & mask] = r;
+        tail.store(t + 1, std::memory_order_release);
+    }
+    // false once closed AND drained
+    inline bool pop(Rec& r) {
+        std::uint64_t h = head.load(std::memory_order_relaxed);
+        int spins = 0;
+        while (h == tail.load(std::memory_order_acquire)) {
+            if (closed.load(std::memory_order_acquire) &&
+                h == tail.load(std::memory_order_acquire))
+                return false;
+            if (++spins > 1024) { std::this_thread::yield(); spins = 0; }
+        }
+        r = buf[h & mask];
+        head.store(h + 1, std::memory_order_release);
+        return true;
+    }
+    inline void close() { closed.store(true, std::memory_order_release); }
+};
+
+// ------------------------------------------------------------- descriptors
+enum class SK : int {
+    FILTER = 1,   // keep when cmp(field op const) holds
+    MAP = 2,      // value transform
+    ACCUM = 3,    // keyed rolling fold (always sum, ref Accumulator)
+    WINDOW = 4,   // keyed sliding window aggregate
+};
+
+enum class Field : int { KEY = 0, ID = 1, TS = 2, VALUE = 3 };
+
+// FILTER ops on (field, p0, p1, d0):
+//   0: (field % p0) == p1      (int fields)
+//   1: field <  d0    2: field >  d0
+//   3: field <= d0    4: field >= d0   5: field == d0
+// MAP ops:
+//   0: value = value * d0 + d1        (affine)
+//   1: value = (double)field * d0 + d1  (load-affine)
+//   2: value = value*value*d0 + d1    (square-affine)
+enum class WKind : int { SUM = 0, COUNT = 1, MAX = 2, MIN = 3 };
+
+struct StageDesc {
+    SK kind;
+    int field = 3, op = 0;
+    i64 p0 = 0, p1 = 0, p2 = 0, p3 = 0;
+    double d0 = 0, d1 = 0;
+};
+
+inline double field_of(const Rec& r, int f) {
+    switch (static_cast<Field>(f)) {
+        case Field::KEY: return (double)r.key;
+        case Field::ID: return (double)r.id;
+        case Field::TS: return (double)r.ts;
+        default: return r.value;
+    }
+}
+inline i64 ifield_of(const Rec& r, int f) {
+    switch (static_cast<Field>(f)) {
+        case Field::KEY: return r.key;
+        case Field::ID: return r.id;
+        case Field::TS: return r.ts;
+        default: return (i64)r.value;
+    }
+}
+
+inline bool filter_pass(const StageDesc& s, const Rec& r) {
+    switch (s.op) {
+        case 0: {
+            if (static_cast<Field>(s.field) == Field::VALUE) {
+                // float modulo matches the Python expression semantics
+                // (truncating to i64 would pass 4.5 % 4 == 0)
+                double m = std::fmod(r.value, (double)s.p0);
+                if (m < 0) m += s.p0 < 0 ? (double)-s.p0 : (double)s.p0;
+                return m == (double)s.p1;
+            }
+            i64 v = ifield_of(r, s.field);
+            i64 m = v % s.p0;
+            if (m < 0) m += s.p0 < 0 ? -s.p0 : s.p0;
+            return m == s.p1;
+        }
+        case 1: return field_of(r, s.field) < s.d0;
+        case 2: return field_of(r, s.field) > s.d0;
+        case 3: return field_of(r, s.field) <= s.d0;
+        case 4: return field_of(r, s.field) >= s.d0;
+        case 5: return field_of(r, s.field) == s.d0;
+        default: return true;
+    }
+}
+
+inline void map_apply(const StageDesc& s, Rec& r) {
+    switch (s.op) {
+        case 0: r.value = r.value * s.d0 + s.d1; break;
+        case 1: r.value = field_of(r, s.field) * s.d0 + s.d1; break;
+        case 2: r.value = r.value * r.value * s.d0 + s.d1; break;
+    }
+}
+
+// --------------------------------------------------- keyed window operator
+// Record-at-a-time incremental Win_Seq: per-key ring of open-window
+// accumulators, fired in wid order as the stream crosses each window's
+// end (the reference's incremental path, win_seq.hpp:429-494).
+// In-order per key; late tuples (before next_fire's start) are dropped
+// and counted (DEFAULT-mode ignore, win_seq.hpp:359-380).
+struct WinOp {
+    i64 win, slide;
+    bool is_tb;
+    bool renumber;  // CB in DEFAULT mode: dense per-key arrival ids
+                    // (win_seq.hpp:342-347)
+    WKind kind;
+    i64 wpp;  // max simultaneously open windows per key
+
+    struct KState {
+        i64 next_fire = 0;
+        i64 max_seen = -1;
+        i64 arrivals = 0;
+        std::vector<double> acc;
+        std::vector<i64> cnt;
+        std::vector<i64> last_ts;
+    };
+    std::unordered_map<i64, KState> keys;
+    i64 dropped = 0;
+
+    WinOp(i64 w, i64 s, bool tb, WKind k, bool rn = false)
+        : win(w), slide(s), is_tb(tb), renumber(rn), kind(k),
+          wpp((w + s - 1) / s) {}
+
+    inline double neutral() const {
+        switch (kind) {
+            case WKind::MAX: return -std::numeric_limits<double>::infinity();
+            case WKind::MIN: return std::numeric_limits<double>::infinity();
+            default: return 0.0;
+        }
+    }
+    inline void combine(double& a, const Rec& r) const {
+        switch (kind) {
+            case WKind::SUM: a += r.value; break;
+            case WKind::COUNT: a += 1.0; break;
+            case WKind::MAX: a = r.value > a ? r.value : a; break;
+            case WKind::MIN: a = r.value < a ? r.value : a; break;
+        }
+    }
+
+    template <typename Emit>
+    inline void fire_upto(i64 key, KState& st, i64 w_min, Emit&& emit) {
+        while (st.next_fire < w_min) {
+            i64 w = st.next_fire;
+            std::size_t slot = (std::size_t)(w % wpp);
+            bool empty = st.cnt[slot] == 0;
+            Rec out;
+            out.key = key;
+            out.id = w;
+            out.ts = is_tb ? w * slide + win - 1
+                           : (empty ? 0 : st.last_ts[slot]);
+            out.value = empty ? 0.0 : st.acc[slot];  // masked neutral
+            emit(out);
+            st.acc[slot] = neutral();
+            st.cnt[slot] = 0;
+            st.last_ts[slot] = 0;
+            ++st.next_fire;
+        }
+    }
+
+    template <typename Emit>
+    inline void on_tuple(const Rec& r, Emit&& emit) {
+        auto it = keys.find(r.key);
+        if (it == keys.end()) {
+            it = keys.emplace(r.key, KState{}).first;
+            it->second.acc.assign((std::size_t)wpp, neutral());
+            it->second.cnt.assign((std::size_t)wpp, 0);
+            it->second.last_ts.assign((std::size_t)wpp, 0);
+        }
+        KState& st = it->second;
+        i64 x = renumber ? st.arrivals++ : (is_tb ? r.ts : r.id);
+        if (x < 0) x = 0;
+        if (st.max_seen < 0)
+            // first tuple: anchor the fire frontier at its first
+            // containing window -- firing from 0 on an epoch-scale
+            // first id/ts would flood the sink with empty windows
+            st.next_fire = x < win ? 0 : (x - win) / slide + 1;
+        i64 w_min = x < win ? 0 : (x - win) / slide + 1;
+        i64 w_max = x / slide;
+        if (x > st.max_seen) {
+            st.max_seen = x;
+            fire_upto(r.key, st, w_min, emit);
+        } else if (w_max < st.next_fire) {
+            ++dropped;  // late: every window containing it already fired
+            return;
+        }
+        if (w_min < st.next_fire) w_min = st.next_fire;
+        for (i64 w = w_min; w <= w_max; ++w) {
+            std::size_t slot = (std::size_t)(w % wpp);
+            combine(st.acc[slot], r);
+            ++st.cnt[slot];
+            st.last_ts[slot] = r.ts;
+        }
+    }
+
+    template <typename Emit>
+    void eos(Emit&& emit) {
+        // deterministic key order for reproducible EOS tails
+        std::vector<i64> ks;
+        ks.reserve(keys.size());
+        for (auto& [k, st] : keys) ks.push_back(k);
+        std::sort(ks.begin(), ks.end());
+        for (i64 k : ks) {
+            KState& st = keys[k];
+            if (st.max_seen < 0) continue;
+            fire_upto(k, st, st.max_seen / slide + 1, emit);
+        }
+    }
+};
+
+// --------------------------------------------------------------- pipeline
+struct ResultSink {
+    std::mutex mu;
+    std::condition_variable cv;
+    std::deque<Rec> q;
+    bool store;
+    int open_shards = 0;
+    std::atomic<i64> count{0};
+    double sum = 0.0;  // guarded by mu
+    std::mutex sum_mu;
+
+    void deliver(const Rec* rs, std::size_t n) {
+        double part = 0;
+        for (std::size_t i = 0; i < n; ++i) part += rs[i].value;
+        count.fetch_add((i64)n, std::memory_order_relaxed);
+        {
+            std::lock_guard<std::mutex> lk(sum_mu);
+            sum += part;
+        }
+        if (store && n) {
+            std::lock_guard<std::mutex> lk(mu);
+            q.insert(q.end(), rs, rs + n);
+            cv.notify_one();
+        }
+    }
+    void shard_done() {
+        std::lock_guard<std::mutex> lk(mu);
+        if (--open_shards == 0) cv.notify_all();
+    }
+};
+
+struct Pipeline {
+    std::vector<StageDesc> stages;
+    int mode = 0;       // 0 threaded, 1 fused
+    int shards = 1;
+    std::size_t ring_cap = 16384;
+    // synth source: key=i%K, id=i/K, ts=id, value=(i%vmod)*vscale+voff
+    i64 n_events = 0, n_keys = 1, vmod = 97;
+    double vscale = 1.0, voff = 0.0;
+    bool use_feed = false;
+
+    Ring feed{1 << 16};
+    ResultSink sink;
+    std::vector<std::thread> threads;
+    std::atomic<i64> dropped_total{0};
+    double elapsed_s = 0.0;
+    std::atomic<bool> started{false};
+
+    // ---- fused worker: full chain on one key-shard ----
+    void run_fused_shard(int s) {
+        WinOp* w = nullptr;
+        std::vector<StageDesc> pre;  // stages before the window
+        std::unordered_map<i64, double> accum;
+        bool has_accum = false;
+        for (auto& st : stages) {
+            if (st.kind == SK::WINDOW && !w)
+                w = new WinOp(st.p0, st.p1, st.p2 != 0,
+                              static_cast<WKind>((int)st.p3), st.op != 0);
+            else if (!w) pre.push_back(st);
+        }
+        std::vector<Rec> out_buf;
+        out_buf.reserve(4096);
+        auto emit = [&](const Rec& r) {
+            out_buf.push_back(r);
+            if (out_buf.size() >= 4096) {
+                sink.deliver(out_buf.data(), out_buf.size());
+                out_buf.clear();
+            }
+        };
+        auto feed_one = [&](Rec& r) {
+            for (auto& st : pre) {
+                if (st.kind == SK::FILTER) {
+                    if (!filter_pass(st, r)) return;
+                } else if (st.kind == SK::MAP) {
+                    map_apply(st, r);
+                } else if (st.kind == SK::ACCUM) {
+                    has_accum = true;
+                    r.value = (accum[r.key] += r.value);
+                }
+            }
+            if (w) w->on_tuple(r, emit);
+            else emit(r);
+        };
+        if (use_feed) {
+            // shards>1: a dedicated router distributes the feed into
+            // per-shard rings (the feed ring is SPSC; N shards popping
+            // it directly would break the single-consumer contract)
+            Ring* in = shards == 1 ? &feed : shard_in[(std::size_t)s];
+            Rec r;
+            while (in->pop(r)) feed_one(r);
+        } else {
+            i64 K = n_keys;
+            for (i64 i = 0; i < n_events; ++i) {
+                i64 key = i % K;
+                i64 ak = key < 0 ? -key : key;
+                if ((int)(ak % shards) != s) continue;
+                Rec r{key, i / K, i / K,
+                      (double)(i % vmod) * vscale + voff};
+                feed_one(r);
+            }
+        }
+        if (w) {
+            w->eos(emit);
+            dropped_total.fetch_add(w->dropped);
+            delete w;
+        }
+        (void)has_accum;
+        if (!out_buf.empty()) sink.deliver(out_buf.data(), out_buf.size());
+        sink.shard_done();
+    }
+
+    // ---- threaded mode: one thread per stage, SPSC rings between ----
+    // Topology per shard: router ring -> [stage threads...] -> sink.
+    // The source (synth or feed) runs on its own thread and routes to
+    // shard 0's first ring via |key| % shards (the KF_Emitter analog);
+    // each per-shard chain is stage-per-thread.
+    struct ShardChain {
+        std::vector<Ring*> rings;  // n_stages+1 boundaries
+    };
+
+    void run_threaded() {
+        int S = shards;
+        std::vector<ShardChain> chains((std::size_t)S);
+        std::size_t n_st = stages.size();
+        for (auto& c : chains) {
+            c.rings.resize(n_st + 1);
+            for (auto& rp : c.rings) rp = new Ring(ring_cap);
+        }
+        // stage threads
+        for (int s = 0; s < S; ++s) {
+            for (std::size_t j = 0; j < n_st; ++j) {
+                threads.emplace_back([this, &chains, s, j] {
+                    StageDesc st = stages[j];
+                    Ring* in = chains[(std::size_t)s].rings[j];
+                    Ring* out = chains[(std::size_t)s].rings[j + 1];
+                    Rec r;
+                    if (st.kind == SK::FILTER) {
+                        while (in->pop(r))
+                            if (filter_pass(st, r)) out->push(r);
+                    } else if (st.kind == SK::MAP) {
+                        while (in->pop(r)) {
+                            map_apply(st, r);
+                            out->push(r);
+                        }
+                    } else if (st.kind == SK::ACCUM) {
+                        std::unordered_map<i64, double> acc;
+                        while (in->pop(r)) {
+                            r.value = (acc[r.key] += r.value);
+                            out->push(r);
+                        }
+                    } else if (st.kind == SK::WINDOW) {
+                        WinOp w(st.p0, st.p1, st.p2 != 0,
+                                static_cast<WKind>((int)st.p3),
+                                st.op != 0);
+                        auto emit = [&](const Rec& o) { out->push(o); };
+                        while (in->pop(r)) w.on_tuple(r, emit);
+                        w.eos(emit);
+                        dropped_total.fetch_add(w.dropped);
+                    }
+                    out->close();
+                });
+            }
+            // per-shard sink thread drains the last ring
+            threads.emplace_back([this, &chains, s, n_st] {
+                Ring* last = chains[(std::size_t)s].rings[n_st];
+                Rec r;
+                std::vector<Rec> buf;
+                buf.reserve(4096);
+                while (last->pop(r)) {
+                    buf.push_back(r);
+                    if (buf.size() >= 4096) {
+                        sink.deliver(buf.data(), buf.size());
+                        buf.clear();
+                    }
+                }
+                if (!buf.empty()) sink.deliver(buf.data(), buf.size());
+                sink.shard_done();
+            });
+        }
+        // source+router thread (reference: Source_Node -> emitter)
+        threads.emplace_back([this, &chains, S] {
+            if (use_feed) {
+                Rec r;
+                while (feed.pop(r)) {
+                    i64 k = r.key < 0 ? -r.key : r.key;
+                    chains[(std::size_t)(k % S)].rings[0]->push(r);
+                }
+            } else {
+                i64 K = n_keys;
+                for (i64 i = 0; i < n_events; ++i) {
+                    i64 key = i % K;
+                    Rec r{key, i / K, i / K,
+                          (double)(i % vmod) * vscale + voff};
+                    i64 ak = key < 0 ? -key : key;
+                    chains[(std::size_t)(ak % S)].rings[0]->push(r);
+                }
+            }
+            for (auto& c : chains) c.rings[0]->close();
+        });
+        join_all();
+        for (auto& c : chains)
+            for (auto* rp : c.rings) delete rp;
+    }
+
+    std::vector<Ring*> shard_in;  // fused+feed router rings
+
+    void start() {
+        sink.open_shards = shards;
+        started.store(true);
+        if (mode == 1) {
+            if (use_feed && shards > 1) {
+                for (int s = 0; s < shards; ++s)
+                    shard_in.push_back(new Ring(ring_cap));
+                threads.emplace_back([this] {
+                    Rec r;
+                    while (feed.pop(r)) {
+                        i64 k = r.key < 0 ? -r.key : r.key;
+                        shard_in[(std::size_t)(k % shards)]->push(r);
+                    }
+                    for (auto* rp : shard_in) rp->close();
+                });
+            }
+            for (int s = 0; s < shards; ++s)
+                threads.emplace_back([this, s] { run_fused_shard(s); });
+        } else {
+            // run_threaded spawns and joins internally; wrap in a thread
+            threads_outer = new std::thread([this] { run_threaded_outer(); });
+        }
+    }
+    // threaded mode needs an owner thread because it joins its workers
+    std::thread* threads_outer = nullptr;
+    void run_threaded_outer() { run_threaded(); }
+
+    void join_all() {
+        for (auto& t : threads) t.join();
+        threads.clear();
+    }
+
+    void wait() {
+        if (mode == 1) {
+            join_all();
+            for (auto* rp : shard_in) delete rp;
+            shard_in.clear();
+        } else if (threads_outer) {
+            threads_outer->join();
+            delete threads_outer;
+            threads_outer = nullptr;
+        }
+    }
+    ~Pipeline() {
+        wait();
+        for (auto* rp : shard_in) delete rp;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* wfn_rp_new(int mode, int shards, int store_results) {
+    auto* p = new Pipeline();
+    p->mode = mode;
+    p->shards = shards < 1 ? 1 : shards;
+    p->sink.store = store_results != 0;
+    return p;
+}
+
+void wfn_rp_free(void* rp) { delete static_cast<Pipeline*>(rp); }
+
+void wfn_rp_add_stage(void* rp, int kind, int field, int op, i64 p0, i64 p1,
+                      i64 p2, i64 p3, double d0, double d1) {
+    auto* p = static_cast<Pipeline*>(rp);
+    StageDesc s;
+    s.kind = static_cast<SK>(kind);
+    s.field = field;
+    s.op = op;
+    s.p0 = p0; s.p1 = p1; s.p2 = p2; s.p3 = p3;
+    s.d0 = d0; s.d1 = d1;
+    p->stages.push_back(s);
+}
+
+void wfn_rp_set_synth(void* rp, i64 n_events, i64 n_keys, i64 vmod,
+                      double vscale, double voff) {
+    auto* p = static_cast<Pipeline*>(rp);
+    p->use_feed = false;
+    p->n_events = n_events;
+    p->n_keys = n_keys < 1 ? 1 : n_keys;
+    p->vmod = vmod < 1 ? 1 : vmod;
+    p->vscale = vscale;
+    p->voff = voff;
+}
+
+void wfn_rp_set_feed(void* rp) { static_cast<Pipeline*>(rp)->use_feed = true; }
+
+void wfn_rp_start(void* rp) { static_cast<Pipeline*>(rp)->start(); }
+
+// Columnar feed into the record plane (amortizes the GIL crossing);
+// blocks when the ring is full.
+void wfn_rp_feed(void* rp, const i64* keys, const i64* ids, const i64* ts,
+                 const double* vals, i64 n) {
+    auto* p = static_cast<Pipeline*>(rp);
+    for (i64 i = 0; i < n; ++i)
+        p->feed.push(Rec{keys[i], ids[i], ts[i], vals[i]});
+}
+
+void wfn_rp_feed_eos(void* rp) { static_cast<Pipeline*>(rp)->feed.close(); }
+
+// Blocking poll of stored results; returns n copied, sets *done=1 when
+// every shard finished AND the store is drained.
+i64 wfn_rp_poll(void* rp, i64 max_n, i64* keys, i64* wids, i64* ts,
+                double* vals, int* done) {
+    auto* p = static_cast<Pipeline*>(rp);
+    std::unique_lock<std::mutex> lk(p->sink.mu);
+    p->sink.cv.wait(lk, [&] {
+        return !p->sink.q.empty() || p->sink.open_shards == 0;
+    });
+    i64 n = 0;
+    while (n < max_n && !p->sink.q.empty()) {
+        const Rec& r = p->sink.q.front();
+        keys[n] = r.key;
+        wids[n] = r.id;
+        ts[n] = r.ts;
+        vals[n] = r.value;
+        p->sink.q.pop_front();
+        ++n;
+    }
+    *done = (p->sink.open_shards == 0 && p->sink.q.empty()) ? 1 : 0;
+    return n;
+}
+
+void wfn_rp_wait(void* rp, i64* out_count, double* out_sum, i64* out_dropped) {
+    auto* p = static_cast<Pipeline*>(rp);
+    p->wait();
+    *out_count = p->sink.count.load();
+    {
+        std::lock_guard<std::mutex> lk(p->sink.sum_mu);
+        *out_sum = p->sink.sum;
+    }
+    *out_dropped = p->dropped_total.load();
+}
+
+}  // extern "C"

@@ -31,6 +31,11 @@ struct KeyState {
     std::vector<i64> ts;
     std::vector<double> vals;
     i64 next_fire = 0;        // next window (lwid) to fire
+    i64 anchor = 0;           // first window that can ever fire for this
+                              // key (set from the first tuple; windows
+                              // before it are never emitted, matching
+                              // the on-demand window creation of the
+                              // scalar path, win_seq.hpp:417-428)
     i64 opened_max = -1;
     i64 max_id = -1;
     bool needs_sort = false;
@@ -269,8 +274,17 @@ struct Engine {
         }
         for (std::size_t d = 0; d < nd; ++d) {
             KeyState& st = *d_state[d];
-            i64 accept_from = st.next_fire > 0
-                ? (st.next_fire - 1) * slide + win : 0;
+            if (st.max_id < 0 && d_min[d] != INT64_MAX) {
+                // first data for this key: anchor the fire frontier at
+                // the first window containing the earliest tuple --
+                // firing from 0 on an epoch-scale first id/ts would
+                // emit ~id/slide empty windows (flood/OOM)
+                i64 first = d_min[d];
+                st.anchor = first < win ? 0 : (first - win) / slide + 1;
+                st.next_fire = st.anchor;
+            }
+            i64 accept_from = st.next_fire > st.anchor
+                ? (st.next_fire - 1) * slide + win : st.anchor * slide;
             if (d_min[d] < accept_from) {
                 // late tuples behind the fired frontier: compact them
                 // out of the just-appended block (arrival order kept,
@@ -513,7 +527,7 @@ struct Engine {
     // fired-but-unstaged descriptors).  The reference has no
     // checkpointing at all (SURVEY.md §5); this feeds the policy layer
     // in utils/checkpoint.py through the Python state_dict hooks.
-    static constexpr i64 SNAP_MAGIC = 0x31'4E'46'57;  // "WFN1"
+    static constexpr i64 SNAP_MAGIC = 0x32'4E'46'57;  // "WFN2"
 
     template <typename T>
     static void put(std::vector<unsigned char>& b, const T& v) {
@@ -560,7 +574,8 @@ struct Engine {
         put(b, (i64)keys.size());
         for (const auto& [key, st] : keys) {
             put(b, key);
-            put(b, st.next_fire); put(b, st.opened_max); put(b, st.max_id);
+            put(b, st.next_fire); put(b, st.anchor);
+            put(b, st.opened_max); put(b, st.max_id);
             put(b, (i64)((st.dense ? 1 : 0) | (st.base_set ? 2 : 0)
                          | (st.needs_sort ? 4 : 0)));
             put(b, st.dense_base);
@@ -594,6 +609,7 @@ struct Engine {
             i64 key, flags;
             KeyState st;
             if (!get(p, end, key) || !get(p, end, st.next_fire)
+                || !get(p, end, st.anchor)
                 || !get(p, end, st.opened_max) || !get(p, end, st.max_id)
                 || !get(p, end, flags) || !get(p, end, st.dense_base)
                 || !get_vec(p, end, st.ids) || !get_vec(p, end, st.ts)

@@ -1,0 +1,248 @@
+"""Native record-pipeline engine + expression DSL + graph lowering.
+
+Covers native/record_pipeline.cpp (both the thread-per-stage
+reference-architecture mode and the fused fast path), core/expr.py
+pattern matching, and graph/native_lowering.py's transparent run().
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core import F, WinType
+from windflow_tpu.core.basic import RuntimeConfig
+from windflow_tpu.core.expr import match_affine, match_predicate
+from windflow_tpu.core.tuples import BasicRecord, TupleBatch
+from windflow_tpu.operators.basic_ops import Filter, Map, Sink
+from windflow_tpu.operators.batch_ops import BatchSource
+from windflow_tpu.operators.key_farm import KeyFarm
+from windflow_tpu.operators.synth import SyntheticSource
+from windflow_tpu.runtime.native import (NativeRecordPipeline,
+                                         native_available)
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native runtime unavailable")
+
+
+# ---------------------------------------------------------------- expr DSL
+
+def test_expr_eval_record_and_columns():
+    e = (F.value * 2 + 1) % 5
+    r = BasicRecord(3, 7, 7, 4.0)
+    assert e.eval_record(r) == (4.0 * 2 + 1) % 5
+    cols = TupleBatch({"key": np.zeros(3, np.int64),
+                       "id": np.arange(3), "ts": np.arange(3),
+                       "value": np.array([1.0, 2.0, 3.0])})
+    np.testing.assert_allclose(e.eval_columns(cols), (np.array(
+        [1.0, 2.0, 3.0]) * 2 + 1) % 5)
+
+
+def test_match_affine():
+    assert match_affine(F.value * 2 + 1) == ("value", 2.0, 1.0, False)
+    assert match_affine((F.value + 1) * 2) == ("value", 2.0, 2.0, False)
+    assert match_affine(3 - F.id) == ("id", -1.0, 3.0, False)
+    assert match_affine(F.value / 4) == ("value", 0.25, 0.0, False)
+    f, s, o, sq = match_affine(F.value * F.value * 3 + 2)
+    assert (f, s, o, sq) == ("value", 3.0, 2.0, True)
+    assert match_affine(F.value * F.id) is None
+    assert match_affine(F.value % 3) is None
+
+
+def test_match_predicate():
+    assert match_predicate(F.value % 4 == 0) == ("mod_eq", "value", 4, 0)
+    assert match_predicate(F.key % 2 == 1) == ("mod_eq", "key", 2, 1)
+    assert match_predicate(F.value > 3) == ("gt", "value", 3)
+    # affine rewrite: 2*v + 1 <= 7  ->  v <= 3
+    op, field, c = match_predicate(F.value * 2 + 1 <= 7)
+    assert (op, field, c) == ("le", "value", 3.0)
+    # negative scale flips the comparison
+    op, field, c = match_predicate(1 - F.value < 0)
+    assert (op, field, c) == ("gt", "value", 1.0)
+    assert match_predicate(F.value != 0) is None
+    assert match_predicate(F.value % 4 == F.key) is None
+
+
+# ------------------------------------------- record pipeline vs numpy oracle
+
+def _oracle_windows(n, K, win, slide, vmod):
+    i = np.arange(n)
+    keys, ids = i % K, i // K
+    vals = (i % vmod).astype(float) * 2.0
+    keep = np.mod(vals, 4) == 0
+    res = {}
+    for k in range(K):
+        m = keep & (keys == k)
+        kid, kv = ids[m], vals[m]
+        if len(kid) == 0:
+            continue
+        w = 0
+        while w * slide <= kid.max():
+            lo, hi = w * slide, w * slide + win
+            res[(k, w)] = kv[(kid >= lo) & (kid < hi)].sum()
+            w += 1
+    return res
+
+
+@pytest.mark.parametrize("mode,shards", [
+    ("threaded", 1), ("threaded", 3), ("fused", 1), ("fused", 4)])
+def test_record_pipeline_matches_oracle(mode, shards):
+    n, K, win, slide, vmod = 60_000, 8, 32, 16, 97
+    want = _oracle_windows(n, K, win, slide, vmod)
+    rp = NativeRecordPipeline(mode, shards, store_results=True)
+    rp.add_map_affine(2.0).add_filter("value", "mod_eq", m=4, r=0) \
+      .add_window(win, slide, False, "sum")
+    rp.set_synth(n, K, vmod)
+    rp.start()
+    got = {}
+    while True:
+        keys, wids, ts, vals, done = rp.poll()
+        for j in range(len(keys)):
+            got[(int(keys[j]), int(wids[j]))] = vals[j]
+        if done:
+            break
+    _, _, dropped = rp.wait()
+    assert dropped == 0
+    for k, v in want.items():
+        assert abs(got.get(k, 0.0) - v) < 1e-9, (k, got.get(k), v)
+    for k, v in got.items():
+        assert abs(v - want.get(k, 0.0)) < 1e-9, (k, v, want.get(k))
+
+
+def test_record_pipeline_float_mod_filter():
+    """Value-field mod filters use float modulo: 4.5 % 4 != 0 must
+    drop (an i64 truncation would keep it)."""
+    rp = NativeRecordPipeline("fused", 1, store_results=True)
+    rp.add_filter("value", "mod_eq", m=4, r=0)
+    rp.set_feed()
+    rp.start()
+    rp.feed(np.zeros(3, np.int64), np.arange(3), np.arange(3),
+            np.array([4.5, 4.0, 8.0]))
+    rp.feed_eos()
+    vals = []
+    while True:
+        _, _, _, v, done = rp.poll()
+        vals.extend(v.tolist())
+        if done:
+            break
+    rp.wait()
+    assert vals == [4.0, 8.0]
+
+
+# ----------------------------------------------------------- graph lowering
+
+def _run_chain(lower, n=20_000, K=8, win=32, slide=16,
+               win_type=WinType.TB):
+    got = {}
+    lock = threading.Lock()
+
+    def sink(rec):
+        if rec is None:
+            return
+        with lock:
+            got[(rec.key, rec.id)] = rec.value
+
+    cfg = RuntimeConfig(native_record_lowering=lower)
+    g = wf.PipeGraph("t", wf.Mode.DEFAULT, cfg)
+    g.add_source(SyntheticSource(n, K, emit_batches=False, batch=4096)) \
+        .add(Map(F.value * 2 + 1)) \
+        .add(Filter(F.value % 3 == 0)) \
+        .add(KeyFarm("sum", win, slide, win_type, parallelism=3)) \
+        .add_sink(Sink(sink))
+    g.run()
+    return got, getattr(g, "_lowered", False)
+
+
+@pytest.mark.parametrize("win_type", [WinType.TB, WinType.CB])
+def test_lowered_matches_python_plane(win_type):
+    """The natively-lowered chain and the Python scalar plane produce
+    identical window sets (including CB renumbering after a filter)."""
+    nat, lowered = _run_chain(True, win_type=win_type)
+    py, lowered2 = _run_chain(False, win_type=win_type)
+    assert lowered and not lowered2
+    assert nat.keys() == py.keys()
+    for k in py:
+        assert abs(nat[k] - py[k]) < 1e-9, (k, nat[k], py[k])
+
+
+def test_feed_lowering_matches_columnar_plane():
+    """BatchSource-fed lowering == the columnar WinSeqTPU plane."""
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
+
+    n, K = 100_000, 8
+
+    def make_src():
+        state = {"sent": 0}
+
+        def src(ctx):
+            i = state["sent"]
+            if i >= n:
+                return None
+            m = min(32768, n - i)
+            idx = i + np.arange(m)
+            state["sent"] = i + m
+            return TupleBatch({"key": idx % K, "id": idx // K,
+                               "ts": idx // K,
+                               "value": (idx % 97).astype(np.float64)})
+        return src
+
+    tot = {"n": 0, "s": 0.0}
+
+    def sink(rec):
+        if rec is not None:
+            tot["n"] += 1
+            tot["s"] += rec.value
+
+    g = wf.PipeGraph("t", wf.Mode.DEFAULT)
+    g.add_source(BatchSource(make_src())) \
+        .add(Map(F.value * 2)) \
+        .add(Filter(F.value % 4 == 0)) \
+        .add(KeyFarm("sum", 64, 32, WinType.TB, parallelism=2)) \
+        .add_sink(Sink(sink))
+    g.run()
+    assert getattr(g, "_lowered", False)
+
+    tot2 = {"n": 0, "s": 0.0}
+    lock = threading.Lock()
+
+    def sink2(item):
+        if item is None:
+            return
+        with lock:
+            if isinstance(item, TupleBatch):
+                tot2["n"] += len(item)
+                tot2["s"] += float(item["value"].sum())
+            else:
+                tot2["n"] += 1
+                tot2["s"] += item.value
+
+    cfg = RuntimeConfig(native_record_lowering=False)
+    g2 = wf.PipeGraph("t2", wf.Mode.DEFAULT, cfg)
+    g2.add_source(BatchSource(make_src())) \
+        .add(Map(F.value * 2)) \
+        .add(Filter(F.value % 4 == 0)) \
+        .add(WinSeqTPU("sum", 64, 32, WinType.TB, emit_batches=True)) \
+        .add_sink(Sink(sink2))
+    g2.run()
+    assert not getattr(g2, "_lowered", False)
+    assert tot["n"] == tot2["n"]
+    assert abs(tot["s"] - tot2["s"]) < 1e-6 * max(1, abs(tot2["s"]))
+
+
+def test_lowering_refuses_opaque_callables():
+    """An arbitrary Python callable in the chain keeps the graph on the
+    Python plane (lowering is never a semantic change)."""
+    tot = {"n": 0}
+
+    def sink(rec):
+        if rec is not None:
+            tot["n"] += 1
+
+    g = wf.PipeGraph("t", wf.Mode.DEFAULT)
+    g.add_source(SyntheticSource(1000, 2, emit_batches=False)) \
+        .add(Map(lambda t: None)) \
+        .add(KeyFarm("sum", 8, 8, WinType.TB)) \
+        .add_sink(Sink(sink))
+    g.run()
+    assert not getattr(g, "_lowered", False)
+    assert tot["n"] > 0

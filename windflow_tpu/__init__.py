@@ -20,7 +20,7 @@ from .core import (Mode, WinType, OptLevel, RoutingMode, Pattern, WinEvent,
                    OrderingMode, Role, WinOperatorConfig, RuntimeConfig,
                    BasicRecord, TupleBatch, EOS, TriggererCB, TriggererTB,
                    Window, StreamArchive, FlatFAT, Iterable, Shipper,
-                   RuntimeContext, LocalStorage)
+                   RuntimeContext, LocalStorage, Expr, F)
 
 __version__ = "0.1.0"
 

@@ -10,6 +10,7 @@ from .iterable import Iterable
 from .shipper import Shipper
 from .context import RuntimeContext, LocalStorage
 from .meta import arity, is_rich, with_context, default_hash
+from .expr import Expr, F
 from . import win_assign
 
 __all__ = [
@@ -21,4 +22,5 @@ __all__ = [
     "StreamArchive", "FlatFAT", "Iterable", "Shipper",
     "RuntimeContext", "LocalStorage",
     "arity", "is_rich", "with_context", "default_hash", "win_assign",
+    "Expr", "F",
 ]

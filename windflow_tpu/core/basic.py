@@ -159,3 +159,6 @@ class RuntimeConfig:
     dashboard_port: int = 20207
     log_dir: str = "log"
     use_native_runtime: bool = True   # prefer the C++ host runtime when built
+    # lower fully-declared record chains (Expr filters/maps + builtin
+    # window + sink) onto the native C++ record pipeline at run()
+    native_record_lowering: bool = True

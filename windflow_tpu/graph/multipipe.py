@@ -56,6 +56,7 @@ class MultiPipe:
         self.children: List["MultiPipe"] = []  # after split
         self.merged_into: Optional[MultiPipe] = None
         self._op_names: List[str] = []
+        self._ops: List[Operator] = []  # descriptors, for native lowering
 
     # -- internal wiring ---------------------------------------------------
     def _check_open(self):
@@ -74,6 +75,7 @@ class MultiPipe:
         if op.used:
             raise RuntimeError(f"operator {op.name} already used in a graph")
         op.used = True
+        self._ops.append(op)
 
     def _collector_for(self, ordering_mode: Optional[OrderingMode],
                        n_channels: int, win_type: Optional[WinType] = None):

@@ -249,6 +249,10 @@ class PipeGraph:
             f.write(graph_to_dot(self))
 
     def run(self) -> None:
+        if not self._started:
+            from .native_lowering import try_run_native
+            if try_run_native(self):
+                return
         self.start()
         self.wait_end()
 

@@ -24,6 +24,7 @@ from typing import Any, Callable, List, Optional
 
 from ..core.basic import Pattern, RoutingMode, OrderingMode
 from ..core.context import RuntimeContext
+from ..core.expr import Expr
 from ..core.meta import with_context
 from ..core.shipper import Shipper
 from ..runtime.emitters import StandardEmitter
@@ -191,21 +192,54 @@ class _BasicOp(Operator):
 
 
 class Filter(_BasicOp):
+    """Predicate may be a Python callable or a declarative ``Expr``
+    (e.g. ``Filter(F.value % 4 == 0)``) -- expressions additionally let
+    the whole chain lower onto the native C++ record pipeline
+    (graph/native_lowering.py)."""
+
     logic_cls = FilterLogic
     base_arity = 1
 
     def __init__(self, fn, parallelism=1, name="filter", closing_func=None,
                  keyed=False):
+        self.expr = fn if isinstance(fn, Expr) else None
+        if self.expr is not None:
+            # plane-agnostic: records evaluate scalar, TupleBatch
+            # evaluates vectorized over columns
+            import numpy as np
+
+            from ..core.tuples import TupleBatch
+            pred = self.expr.eval_record
+            pred_cols = self.expr.eval_columns
+
+            def fn(t):
+                if isinstance(t, TupleBatch):
+                    out = t.take(np.asarray(pred_cols(t), bool))
+                    return out if len(out) else None
+                return bool(pred(t))
         super().__init__(fn, parallelism, name, closing_func, keyed,
                          Pattern.FILTER)
 
 
 class Map(_BasicOp):
+    """Transform may be a Python callable or a value ``Expr``
+    (``Map(F.value * 2 + 1)`` assigns the expression to ``value``)."""
+
     logic_cls = MapLogic
     base_arity = 1
 
     def __init__(self, fn, parallelism=1, name="map", closing_func=None,
                  keyed=False):
+        self.expr = fn if isinstance(fn, Expr) else None
+        if self.expr is not None:
+            from ..core.tuples import TupleBatch
+            ev = self.expr.eval_record
+            ev_cols = self.expr.eval_columns
+
+            def fn(t):
+                if isinstance(t, TupleBatch):
+                    return t.with_cols(value=ev_cols(t))
+                t.value = ev(t)  # in-place value assignment
         super().__init__(fn, parallelism, name, closing_func, keyed,
                          Pattern.MAP)
 

@@ -86,10 +86,18 @@ class _BatchFilterLogic(NodeLogic):
 
 
 class BatchMap(Operator):
+    """Vectorized transform; also accepts a value ``Expr`` which is
+    evaluated over the batch columns (``BatchMap(F.value * 2)``)."""
+
     def __init__(self, fn, parallelism=1, name="batch_map", keyed=False):
         super().__init__(name, parallelism,
                          RoutingMode.KEYBY if keyed else RoutingMode.FORWARD,
                          Pattern.MAP)
+        from ..core.expr import Expr
+        self.expr = fn if isinstance(fn, Expr) else None
+        if self.expr is not None:
+            ev = self.expr.eval_columns
+            fn = lambda b: b.with_cols(value=ev(b))  # noqa: E731
         self.fn = fn
         self.keyed = keyed
 
@@ -108,10 +116,17 @@ class BatchMap(Operator):
 
 
 class BatchFilter(Operator):
+    """Vectorized predicate; also accepts a boolean ``Expr``
+    (``BatchFilter(F.value % 4 == 0)``)."""
+
     def __init__(self, fn, parallelism=1, name="batch_filter", keyed=False):
         super().__init__(name, parallelism,
                          RoutingMode.KEYBY if keyed else RoutingMode.FORWARD,
                          Pattern.FILTER)
+        from ..core.expr import Expr
+        self.expr = fn if isinstance(fn, Expr) else None
+        if self.expr is not None:
+            fn = self.expr.eval_columns
         self.fn = fn
         self.keyed = keyed
 

@@ -28,6 +28,11 @@ class KeyFarm(Operator):
                          Pattern.KEY_FARM)
         if win_len == 0 or slide_len == 0:
             raise ValueError("window length and slide cannot be zero")
+        self.win_kind_name = win_func if isinstance(win_func, str) else None
+        if self.win_kind_name is not None:
+            from .win_seq import builtin_win_func
+            win_func = builtin_win_func(self.win_kind_name)
+            incremental = False
         self.win_func = win_func
         self.win_len = win_len
         self.slide_len = slide_len

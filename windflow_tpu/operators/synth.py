@@ -1,0 +1,85 @@
+"""SyntheticSource: a declared-parameter benchmark/test source.
+
+The reference's tests all use synthetic sources built inline in each
+binary (e.g. mp_common.hpp:125-163); windflow_tpu additionally makes
+the standard fixture shape a *descriptor* so the whole pipeline can
+lower onto the native C++ record plane (graph/native_lowering.py) and
+run source->...->sink entirely off the Python interpreter.
+
+Stream shape: ``n_events`` records, ``key = i % n_keys``,
+``id = ts = i // n_keys`` (dense in-order per key),
+``value = (i % vmod) * vscale + voff``.
+
+The Python fallback (when the chain cannot lower) emits columnar
+``TupleBatch`` chunks on the batch plane or per-record ``BasicRecord``
+on the scalar plane, identical content either way.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.basic import Pattern, RoutingMode
+from ..core.context import RuntimeContext
+from ..core.tuples import BasicRecord, TupleBatch
+from ..runtime.emitters import StandardEmitter
+from ..runtime.node import SourceLoopLogic
+from .base import Operator, StageSpec
+
+
+class _SynthLogic(SourceLoopLogic):
+    def __init__(self, desc, batch: int, emit_batches: bool):
+        self.desc = desc
+        self.batch = batch
+        self.emit_batches = emit_batches
+        self.sent = 0
+        self.context = RuntimeContext(1, 0)
+
+        def step(emit):
+            d = self.desc
+            i = self.sent
+            if i >= d.n_events:
+                return False
+            n = min(self.batch, d.n_events - i)
+            idx = i + np.arange(n)
+            keys = idx % d.n_keys
+            ids = idx // d.n_keys
+            vals = (idx % d.vmod).astype(np.float64) * d.vscale + d.voff
+            self.sent = i + n
+            if self.emit_batches:
+                emit(TupleBatch({"key": keys, "id": ids, "ts": ids,
+                                 "value": vals}))
+            else:
+                for j in range(n):
+                    emit(BasicRecord(int(keys[j]), int(ids[j]),
+                                     int(ids[j]), float(vals[j])))
+            return True
+
+        super().__init__(step)
+
+
+class SyntheticSource(Operator):
+    """Descriptor source: key=i%K, id=ts=i//K, value=(i%vmod)*vscale+voff.
+
+    ``emit_batches=True`` (default) emits TupleBatch chunks (columnar
+    plane); False emits BasicRecords (scalar plane).  Either way the
+    native lowering replaces it with the C++ synthetic generator when
+    the rest of the chain lowers.
+    """
+
+    def __init__(self, n_events: int, n_keys: int = 1, vmod: int = 97,
+                 vscale: float = 1.0, voff: float = 0.0,
+                 batch: int = 65536, emit_batches: bool = True,
+                 name: str = "synthetic_source"):
+        super().__init__(name, 1, RoutingMode.NONE, Pattern.SOURCE)
+        self.n_events = n_events
+        self.n_keys = max(1, n_keys)
+        self.vmod = max(1, vmod)
+        self.vscale = vscale
+        self.voff = voff
+        self.batch = batch
+        self.emit_batches = emit_batches
+
+    def stages(self):
+        return [StageSpec(self.name,
+                          [_SynthLogic(self, self.batch, self.emit_batches)],
+                          StandardEmitter(), self.routing)]

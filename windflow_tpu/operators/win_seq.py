@@ -204,25 +204,60 @@ class WinSeqLogic(NodeLogic):
         self.ignored_tuples = state["ignored"]
 
 
+def builtin_win_func(kind: str):
+    """Non-incremental window function for a builtin aggregate name
+    (sum/count/max/min).  Empty windows produce the masked neutral 0,
+    matching the columnar/native planes (window_compute.py)."""
+    if kind == "sum":
+        def f(gwid, it, res):
+            res.value = sum(t.value for t in it)
+    elif kind == "count":
+        def f(gwid, it, res):
+            res.value = float(len(it))
+    elif kind == "max":
+        def f(gwid, it, res):
+            res.value = max((t.value for t in it), default=0.0)
+    elif kind == "min":
+        def f(gwid, it, res):
+            res.value = min((t.value for t in it), default=0.0)
+    else:
+        raise ValueError(f"unknown builtin window kind {kind!r}")
+    return f
+
+
 class WinSeq(Operator):
-    """Standalone sequential window operator (parallelism 1)."""
+    """Standalone sequential window operator (parallelism 1).
+
+    ``win_func`` may be a callable or a builtin aggregate name
+    ("sum"/"count"/"max"/"min") -- builtin names additionally let the
+    chain lower onto the native C++ record pipeline
+    (graph/native_lowering.py)."""
 
     def __init__(self, win_func, win_len, slide_len, win_type,
                  triggering_delay=0, incremental=False, name="win_seq",
                  result_factory=BasicRecord, closing_func=None):
         super().__init__(name, 1, RoutingMode.FORWARD, Pattern.WIN_SEQ)
+        self.win_kind_name = win_func if isinstance(win_func, str) else None
+        if self.win_kind_name is not None:
+            win_func = builtin_win_func(self.win_kind_name)
+            incremental = False
         self.kwargs = dict(
             win_func=win_func, win_len=win_len, slide_len=slide_len,
             win_type=win_type, triggering_delay=triggering_delay,
             incremental=incremental, result_factory=result_factory,
             closing_func=closing_func)
         self.win_type = win_type
+        self._renumbering = False
+
+    def enable_renumbering(self):
+        self._renumbering = True
 
     def make_logic(self, renumbering=False) -> WinSeqLogic:
         return WinSeqLogic(renumbering=renumbering, **self.kwargs)
 
     def stages(self):
         return [StageSpec(
-            self.name, [self.make_logic()], StandardEmitter(), self.routing,
+            self.name, [self.make_logic(renumbering=self._renumbering)],
+            StandardEmitter(), self.routing,
             ordering_mode=(OrderingMode.ID if self.win_type == WinType.CB
                            else OrderingMode.TS))]

@@ -23,7 +23,8 @@ _lib_lock = threading.Lock()
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native")
 _SRCS = [os.path.join(_NATIVE_DIR, f)
-         for f in ("windflow_native.cpp", "window_engine.cpp")]
+         for f in ("windflow_native.cpp", "window_engine.cpp",
+                   "record_pipeline.cpp")]
 _SO = os.path.join(_NATIVE_DIR, "libwindflow_native.so")
 
 
@@ -118,6 +119,22 @@ def get_lib():
         lib.wfn_engine_deserialize.restype = ctypes.c_int
         lib.wfn_engine_deserialize.argtypes = [ctypes.c_void_p,
                                                ctypes.c_char_p, LL]
+        lib.wfn_rp_new.restype = ctypes.c_void_p
+        lib.wfn_rp_new.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib.wfn_rp_free.argtypes = [ctypes.c_void_p]
+        lib.wfn_rp_add_stage.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            LL, LL, LL, LL, ctypes.c_double, ctypes.c_double]
+        lib.wfn_rp_set_synth.argtypes = [ctypes.c_void_p, LL, LL, LL,
+                                         ctypes.c_double, ctypes.c_double]
+        lib.wfn_rp_set_feed.argtypes = [ctypes.c_void_p]
+        lib.wfn_rp_start.argtypes = [ctypes.c_void_p]
+        lib.wfn_rp_feed.argtypes = [ctypes.c_void_p, PLL, PLL, PLL, PD, LL]
+        lib.wfn_rp_feed_eos.argtypes = [ctypes.c_void_p]
+        lib.wfn_rp_poll.restype = LL
+        lib.wfn_rp_poll.argtypes = [ctypes.c_void_p, LL, PLL, PLL, PLL, PD,
+                                    ctypes.POINTER(ctypes.c_int)]
+        lib.wfn_rp_wait.argtypes = [ctypes.c_void_p, PLL, PD, PLL]
         _lib = lib
         return lib
 
@@ -214,6 +231,151 @@ def pane_reduce(values, pos, kind: str):
     else:
         return None
     return out
+
+
+class NativeRecordPipeline:
+    """ctypes wrapper over the native record-at-a-time pipeline engine
+    (native/record_pipeline.cpp).
+
+    ``mode="threaded"`` is the reference-architecture baseline (one
+    thread per operator stage over SPSC rings -- the FastFlow design,
+    SURVEY.md L0); ``mode="fused"`` is the chain-fused fast host path
+    (multipipe.hpp:345-390 applied end-to-end) with ``shards``
+    key-sharded workers.
+
+    Stages are added in pipeline order with the expression-descriptor
+    helpers; the source is either native-synthetic (``set_synth``) or
+    Python-fed columnar batches (``set_feed`` + ``feed``/``feed_eos``).
+    """
+
+    __slots__ = ("lib", "ptr", "_started", "_waited", "_store")
+
+    FIELDS = {"key": 0, "id": 1, "ts": 2, "value": 3}
+    WKINDS = {"sum": 0, "count": 1, "max": 2, "min": 3}
+    _FILTER_OPS = {"mod_eq": 0, "lt": 1, "gt": 2, "le": 3, "ge": 4, "eq": 5}
+
+    def __init__(self, mode: str = "fused", shards: int = 1,
+                 store_results: bool = False):
+        self.lib = get_lib()
+        if self.lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self.ptr = self.lib.wfn_rp_new(
+            {"threaded": 0, "fused": 1}[mode], shards,
+            1 if store_results else 0)
+        self._started = False
+        self._waited = False
+        self._store = store_results
+
+    # -- stage construction -------------------------------------------
+    def add_filter(self, field: str, op: str, *, m: int = 0, r: int = 0,
+                   const: float = 0.0) -> "NativeRecordPipeline":
+        """op in mod_eq (keep when field % m == r) | lt|gt|le|ge|eq
+        (compare field against const)."""
+        self.lib.wfn_rp_add_stage(self.ptr, 1, self.FIELDS[field],
+                                  self._FILTER_OPS[op], m, r, 0, 0,
+                                  const, 0.0)
+        return self
+
+    def add_map_affine(self, scale: float, offset: float = 0.0,
+                       square: bool = False) -> "NativeRecordPipeline":
+        """value = value*scale + offset (or value^2*scale + offset)."""
+        self.lib.wfn_rp_add_stage(self.ptr, 2, 3, 2 if square else 0,
+                                  0, 0, 0, 0, scale, offset)
+        return self
+
+    def add_map_load(self, field: str, scale: float = 1.0,
+                     offset: float = 0.0) -> "NativeRecordPipeline":
+        """value = field*scale + offset."""
+        self.lib.wfn_rp_add_stage(self.ptr, 2, self.FIELDS[field], 1,
+                                  0, 0, 0, 0, scale, offset)
+        return self
+
+    def add_accumulator(self) -> "NativeRecordPipeline":
+        """Keyed rolling sum (the reference Accumulator)."""
+        self.lib.wfn_rp_add_stage(self.ptr, 3, 3, 0, 0, 0, 0, 0, 0.0, 0.0)
+        return self
+
+    def add_window(self, win_len: int, slide_len: int, is_tb: bool,
+                   kind: str = "sum",
+                   renumber: bool = False) -> "NativeRecordPipeline":
+        self.lib.wfn_rp_add_stage(self.ptr, 4, 3, 1 if renumber else 0,
+                                  win_len, slide_len,
+                                  1 if is_tb else 0, self.WKINDS[kind],
+                                  0.0, 0.0)
+        return self
+
+    # -- source -------------------------------------------------------
+    def set_synth(self, n_events: int, n_keys: int, vmod: int = 97,
+                  vscale: float = 1.0, voff: float = 0.0) -> None:
+        """Native synthetic source: key=i%K, id=ts=i//K,
+        value=(i%vmod)*vscale+voff (the bench/test fixture shape)."""
+        self.lib.wfn_rp_set_synth(self.ptr, n_events, n_keys, vmod,
+                                  vscale, voff)
+
+    def set_feed(self) -> None:
+        self.lib.wfn_rp_set_feed(self.ptr)
+
+    def feed(self, keys, ids, ts, vals) -> None:
+        import numpy as np
+        LL = ctypes.c_longlong
+        keys = np.ascontiguousarray(keys, np.int64)
+        ids = np.ascontiguousarray(ids, np.int64)
+        ts = np.ascontiguousarray(ts, np.int64)
+        vals = np.ascontiguousarray(vals, np.float64)
+        self.lib.wfn_rp_feed(
+            self.ptr, keys.ctypes.data_as(ctypes.POINTER(LL)),
+            ids.ctypes.data_as(ctypes.POINTER(LL)),
+            ts.ctypes.data_as(ctypes.POINTER(LL)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            len(keys))
+
+    def feed_eos(self) -> None:
+        self.lib.wfn_rp_feed_eos(self.ptr)
+
+    # -- execution ----------------------------------------------------
+    def start(self) -> None:
+        self._started = True
+        self.lib.wfn_rp_start(self.ptr)
+
+    def poll(self, max_n: int = 65536):
+        """Blocking poll of stored results; returns (keys, wids, ts,
+        vals, done). Requires store_results=True."""
+        import numpy as np
+        LL = ctypes.c_longlong
+        keys = np.empty(max_n, np.int64)
+        wids = np.empty(max_n, np.int64)
+        ts = np.empty(max_n, np.int64)
+        vals = np.empty(max_n, np.float64)
+        done = ctypes.c_int()
+        n = self.lib.wfn_rp_poll(
+            self.ptr, max_n, keys.ctypes.data_as(ctypes.POINTER(LL)),
+            wids.ctypes.data_as(ctypes.POINTER(LL)),
+            ts.ctypes.data_as(ctypes.POINTER(LL)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ctypes.byref(done))
+        return (keys[:n], wids[:n], ts[:n], vals[:n], bool(done.value))
+
+    def wait(self):
+        """Join all pipeline threads; returns (n_results, result_sum,
+        dropped)."""
+        LL = ctypes.c_longlong
+        count, dropped = LL(), LL()
+        total = ctypes.c_double()
+        self.lib.wfn_rp_wait(self.ptr, ctypes.byref(count),
+                             ctypes.byref(total), ctypes.byref(dropped))
+        self._waited = True
+        return count.value, total.value, dropped.value
+
+    def __del__(self):
+        lib, ptr = getattr(self, "lib", None), getattr(self, "ptr", None)
+        if lib is not None and ptr:
+            if self._started and not self._waited:
+                # joining requires the feed to be closed; best effort
+                try:
+                    self.feed_eos()
+                except Exception:
+                    pass
+            lib.wfn_rp_free(ptr)
 
 
 class NativeWindowEngine:
