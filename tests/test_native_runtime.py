@@ -227,20 +227,23 @@ def test_engine_deserialize_rejects_huge_length_field():
     e1.ingest(np.zeros(10, np.int64), np.arange(10, dtype=np.int64),
               np.arange(10, dtype=np.int64), np.ones(10))
     blob = bytearray(e1.serialize())
-    # the first vector-length field (st.ids) sits after the fixed
-    # header: magic,win,slide,delay,tb,rn,kind,nkeys + per-key
-    # key,next_fire,opened_max,max_id,flags,dense_base = 14 i64s
-    off = 14 * 8
     import struct
-    # the dense lane may serialize empty ids/ts vectors; walk to the
-    # first non-empty vector length field and corrupt that one
+    # parse the snapshot framing (window_engine.cpp serialize()): the
+    # 8-i64 header (magic,win,slide,delay,tb,rn,kind,nkeys) and the
+    # first key's 7 fixed i64s (key,next_fire,anchor,opened_max,max_id,
+    # flags,dense_base), then walk the three per-key vectors (ids, ts,
+    # vals) by their length headers and corrupt the first non-empty one
+    off = 8 * 8 + 7 * 8
+    corrupted = False
     for _ in range(3):
         n = struct.unpack_from("<q", blob, off)[0]
+        assert 0 <= n <= 10  # framing sanity: a plausible vector length
         if n > 0:
+            struct.pack_into("<q", blob, off, 1 << 61)
+            corrupted = True
             break
         off += 8 + n * 8
-    assert n == 10  # layout check: we found the right field
-    struct.pack_into("<q", blob, off, 1 << 61)
+    assert corrupted  # 10 staged values: some vector must be non-empty
     e2 = NativeWindowEngine(32, 16, True)
     with pytest.raises(ValueError):
         e2.deserialize(bytes(blob))

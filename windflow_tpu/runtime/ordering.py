@@ -123,6 +123,12 @@ class KSlackLogic(NodeLogic):
         self.ts_sample: List[int] = []   # delays sampled since last advance
         self.last_timestamp = 0
         self.dropped = 0
+        # control fields of every dropped record, for exact accounting
+        # oracles (each source tuple is either emitted in-order exactly
+        # once or appears here): the reference only counts
+        # (kslack_node.hpp dropped_inputs); keeping identities costs
+        # nothing at streaming scale relative to the sort buffer
+        self.dropped_records: List = []
         self.on_drop = on_drop or (lambda n: None)
         self.key_counters: Dict[Any, int] = {}
 
@@ -131,6 +137,7 @@ class KSlackLogic(NodeLogic):
             ts = rec.get_control_fields()[2]
             if ts < self.last_timestamp:
                 self.dropped += 1
+                self.dropped_records.append(rec.get_control_fields())
                 self.on_drop(1)
                 continue
             self.last_timestamp = ts
