@@ -105,7 +105,7 @@ enum class Field : int { KEY = 0, ID = 1, TS = 2, VALUE = 3 };
 //   0: value = value * d0 + d1        (affine)
 //   1: value = (double)field * d0 + d1  (load-affine)
 //   2: value = value*value*d0 + d1    (square-affine)
-enum class WKind : int { SUM = 0, COUNT = 1, MAX = 2, MIN = 3 };
+enum class WKind : int { SUM = 0, COUNT = 1, MAX = 2, MIN = 3, MEAN = 4 };
 
 struct StageDesc {
     SK kind;
@@ -201,7 +201,8 @@ struct WinOp {
     }
     inline void combine(double& a, const Rec& r) const {
         switch (kind) {
-            case WKind::SUM: a += r.value; break;
+            case WKind::SUM:
+            case WKind::MEAN: a += r.value; break;
             case WKind::COUNT: a += 1.0; break;
             case WKind::MAX: a = r.value > a ? r.value : a; break;
             case WKind::MIN: a = r.value < a ? r.value : a; break;
@@ -219,7 +220,9 @@ struct WinOp {
             out.id = w;
             out.ts = is_tb ? w * slide + win - 1
                            : (empty ? 0 : st.last_ts[slot]);
-            out.value = empty ? 0.0 : st.acc[slot];  // masked neutral
+            out.value = empty ? 0.0                  // masked neutral
+                : kind == WKind::MEAN ? st.acc[slot] / (double)st.cnt[slot]
+                                      : st.acc[slot];
             emit(out);
             st.acc[slot] = neutral();
             st.cnt[slot] = 0;

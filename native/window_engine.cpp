@@ -3,12 +3,27 @@
 // buffers -> PJRT device buffers)" belongs in the native runtime).
 //
 // Covers the hot standalone case of Win_Seq_TPU (role SEQ, identity
-// WinOperatorConfig, int64 keys, builtin 'sum' with pane pre-reduction):
-// ingest columnar batches, maintain per-key sorted series, detect fired
-// windows, and stage pane-reduced flat buffers + extents for one XLA
-// launch.  The Python engine (operators/tpu/win_seq_tpu.py) delegates
-// here when the workload matches and falls back otherwise (roles,
-// custom functors, string keys).
+// WinOperatorConfig, int64 keys, builtin combines): ingest columnar
+// batches, detect fired windows, and stage pane-partial flat buffers +
+// extents for one XLA launch.  The Python engine
+// (operators/tpu/win_seq_tpu.py) delegates here when the workload
+// matches and falls back otherwise (roles, custom functors, string
+// keys).
+//
+// The state model is the Pane decomposition (Li et al., SIGMOD 2005;
+// reference wf/pane_farm.hpp:33-35) applied at INGEST time: because the
+// engine only runs builtin associative combines, it never stores the
+// tuple stream at all.  Each key holds a small ring of pane
+// accumulators (pane = gcd(win, slide), so every window is an exact
+// pane range) and each tuple is folded into its pane on arrival -- one
+// load+combine+store on a hot cache line, instead of the scatter-copy
+// of the full value series that a CUDA staging design implies
+// (win_seq_gpu.hpp:552-596 archives tuples per key and re-reads them
+// per batch; on a TPU host that second pass is pure memory-bandwidth
+// waste).  Late tuples within the retained pane range fold in exactly
+// like the archive insert would; tuples behind the fired frontier are
+// dropped, matching the scalar path's acceptance rule
+// (win_seq.hpp:417-428).
 //
 // GIL-free: every entry point only touches caller-provided arrays and
 // internal state; Python calls via ctypes release the GIL.
@@ -25,11 +40,19 @@ namespace {
 
 using i64 = long long;
 
+constexpr double INF = std::numeric_limits<double>::infinity();
+
 struct KeyState {
-    std::vector<i64> ids;     // sort keys (tuple id for CB, ts for TB);
-                              // EMPTY while `dense` (ids implicit)
-    std::vector<i64> ts;
-    std::vector<double> vals;
+    // pane-partial ring: pacc[j] is the combine partial of absolute
+    // pane (pane_base + j); pcnt[j] its tuple count.  plid/plts track
+    // the max tuple id seen per pane and its timestamp -- the CB
+    // result-timestamp lane (result ts = ts of the last tuple in the
+    // window extent, matching the host engine); empty for TB windows,
+    // whose result ts is pure window arithmetic.
+    std::vector<double> pacc;
+    std::vector<i64> pcnt;
+    std::vector<i64> plid, plts;
+    i64 pane_base = 0;        // absolute pane index of pacc[0]
     i64 next_fire = 0;        // next window (lwid) to fire
     i64 anchor = 0;           // first window that can ever fire for this
                               // key (set from the first tuple; windows
@@ -38,74 +61,36 @@ struct KeyState {
                               // scalar path, win_seq.hpp:417-428)
     i64 opened_max = -1;
     i64 max_id = -1;
-    bool needs_sort = false;
-    // Dense fast lane: while every id arrives exactly one past the
-    // previous (the ordered-stream common case), the id column is never
-    // materialized -- vals[j] has id `dense_base + j`, pane edges are
-    // position arithmetic, and eviction is a prefix drop.  Any gap or
-    // reordering materializes the ids and falls back to the general
-    // sorted-column path for this key.
-    bool dense = true;
-    bool base_set = false;
-    i64 dense_base = 0;       // id of vals[0] (valid when base_set)
-
-    void materialize(i64 upto) {
-        ids.resize(vals.size());
-        for (i64 j = 0; j < upto; ++j) ids[j] = dense_base + j;
-        dense = false;
-    }
-
-    // Record one id at write position w: stays on the dense lane while
-    // ids arrive contiguously, otherwise materializes and falls back to
-    // the explicit sorted column.  `last` is the previous id (for the
-    // needs_sort check on the general path).
-    inline void append_id(i64 id, i64 w, i64 last) {
-        if (dense) {
-            if (!base_set) {
-                dense_base = id;
-                base_set = true;
-                return;
-            }
-            if (id == dense_base + w) return;
-            materialize(w);
-        }
-        ids[w] = id;
-        if (id < last) needs_sort = true;
-    }
-
-    // Position of the first tuple with sort key >= id on the dense lane.
-    inline i64 pos_of(i64 id) const {
-        i64 p = id - dense_base;
-        i64 sz = (i64)vals.size();
-        return p < 0 ? 0 : (p > sz ? sz : p);
-    }
+    i64 arrivals = 0;         // renumber lane: running arrival count
+                              // (ids implicit, persists across eviction)
 };
 
 struct Desc {
     i64 key, lwid, start, end;
 };
 
-enum class Kind : int { SUM = 0, COUNT = 1, MAX = 2, MIN = 3 };
+enum class Kind : int { SUM = 0, COUNT = 1, MAX = 2, MIN = 3, MEAN = 4 };
 
 struct Engine {
     i64 win, slide, delay;
     bool is_tb;
     bool renumber;            // ids are implicit per-key arrival order
                               // (TS_RENUMBERING analogue): the id input
-                              // is ignored and every key stays on the
-                              // dense lane permanently
-    Kind kind;                // builtin combine staged as pane partials
+                              // is ignored
+    Kind kind;
     i64 pane;                 // gcd(win, slide)
+    int pshift;               // log2(pane) when pane is a power of two
+    double neutral;
     std::unordered_map<i64, KeyState> keys;
     std::vector<Desc> ready;
+    i64 ignored = 0;          // tuples dropped behind the fired frontier
     // staging buffers (valid until the next flush)
-    std::vector<double> st_vals;
+    std::vector<double> st_vals, st_cnts;
     std::vector<i64> st_starts, st_ends, st_keys, st_gwids, st_rts;
     // scatter-ingest machinery: an open-addressing table maps key ->
     // (KeyState*, per-call dense index).  Pass 1 does ONE table probe
-    // per tuple and counts per key; pass 2 writes each tuple straight
-    // into its key's arrays through a cursor.  Dense indices survive
-    // table growth (only slots move), so slot_of stays valid.
+    // per tuple and gathers per-key min/max; pass 2 folds each tuple
+    // into its pane through the cached state pointer.
     std::vector<i64> tab_key;
     std::vector<KeyState*> tab_state;
     std::vector<i64> tab_stamp;
@@ -113,17 +98,23 @@ struct Engine {
     i64 call_id = 0;
     // per-call dense arrays (index = order of first touch this call)
     std::vector<KeyState*> d_state;
-    std::vector<i64> d_key, d_count, d_write, d_last, d_min, d_max;
+    std::vector<i64> d_key, d_count, d_min, d_max, d_accept;
     std::vector<int32_t> slot_of;  // per-tuple dense index
     static constexpr i64 EMPTY = INT64_MIN;
 
     Engine(i64 w, i64 s, bool tb, i64 d, bool renum, Kind k)
         : win(w), slide(s), delay(tb ? d : 0), is_tb(tb), renumber(renum),
           kind(k), pane(std::gcd(w, s)) {
+        pshift = (pane & (pane - 1)) == 0 ? __builtin_ctzll(pane) : -1;
+        neutral = kind == Kind::MAX ? -INF : kind == Kind::MIN ? INF : 0.0;
         tab_key.assign(1024, EMPTY);
         tab_state.assign(1024, nullptr);
         tab_stamp.assign(1024, -1);
         tab_dense.assign(1024, 0);
+    }
+
+    inline i64 pane_of(i64 id) const {
+        return pshift >= 0 ? id >> pshift : id / pane;
     }
 
     void grow_table() {
@@ -169,15 +160,45 @@ struct Engine {
         if (tab_stamp[h] != call_id) {
             tab_stamp[h] = call_id;
             tab_dense[h] = (int32_t)d_key.size();
-            d_key.push_back(key);
+            d_key.push_back(tab_key[h]);
             d_state.push_back(tab_state[h]);
             d_count.push_back(0);
         }
         return tab_dense[h];
     }
 
-    // TV = double or float: f32 sources ingest without a host-side
-    // widening copy (values widen per element at the scatter write)
+    // grow the pane ring so relative pane p_rel is addressable
+    inline void ensure_pane(KeyState& st, i64 p_rel) {
+        if (p_rel < (i64)st.pacc.size()) return;
+        // geometric headroom: rings grow a few panes per batch; the
+        // +8 keeps amortized growth O(1) without doubling a large ring
+        i64 n = p_rel + 1 + std::min<i64>(p_rel / 2 + 8, 4096);
+        st.pacc.resize(n, neutral);
+        st.pcnt.resize(n, 0);
+        if (!is_tb) {
+            st.plid.resize(n, INT64_MIN);
+            st.plts.resize(n, 0);
+        }
+    }
+
+    inline void fold(KeyState& st, i64 p_rel, double v) {
+        switch (kind) {
+            case Kind::COUNT: st.pacc[p_rel] += 1.0; break;
+            case Kind::MAX:
+                if (v > st.pacc[p_rel]) st.pacc[p_rel] = v;
+                break;
+            case Kind::MIN:
+                if (v < st.pacc[p_rel]) st.pacc[p_rel] = v;
+                break;
+            case Kind::SUM:
+            case Kind::MEAN:
+            default: st.pacc[p_rel] += v; break;
+        }
+        ++st.pcnt[p_rel];
+    }
+
+    // TV = double or float: f32 sources fold without a host-side
+    // widening copy (values widen at the accumulate)
     template <typename TV>
     void ingest_batch(const i64* bkeys, const i64* ids, const i64* tss,
                       const TV* vals, i64 n) {
@@ -186,95 +207,39 @@ struct Engine {
         d_state.clear();
         d_count.clear();
         if ((i64)slot_of.size() < n) slot_of.resize(n);
-        for (i64 j = 0; j < n; ++j) {
-            int32_t d = dense_of(bkeys[j]);
-            ++d_count[d];
-            slot_of[j] = d;
-        }
-        std::size_t nd = d_key.size();
-        d_write.resize(nd);
-        d_last.resize(nd);
-        d_min.assign(nd, INT64_MAX);
-        d_max.assign(nd, INT64_MIN);
-        for (std::size_t d = 0; d < nd; ++d) {
-            KeyState& st = *d_state[d];
-            std::size_t base = st.vals.size();
-            if (renumber) {
-                // implicit arrival-order ids: the anchor is the key's
-                // running tuple count, persisted across evictions
-                if (!st.base_set) {
-                    st.dense_base = 0;
-                    st.base_set = true;
-                }
-            } else if (base == 0) {
-                // empty state re-anchors the dense lane: contiguity is
-                // only needed for position arithmetic within the
-                // retained buffer, so a gap across a full eviction is
-                // harmless
-                st.dense = true;
-                st.base_set = false;
-                st.ids.clear();
-            }
-            if (!st.dense) st.ids.resize(base + d_count[d]);
-            if (!is_tb) st.ts.resize(base + d_count[d]);
-            st.vals.resize(base + d_count[d]);
-            d_write[d] = (i64)base;
-            d_last[d] = base == 0 ? INT64_MIN
-                : (st.dense ? st.dense_base + (i64)base - 1
-                            : st.ids[base - 1]);
-        }
         if (renumber) {
-            // ids input ignored; every key is permanently dense
-            if (is_tb) {
-                for (i64 j = 0; j < n; ++j) {
-                    int32_t d = slot_of[j];
-                    d_state[d]->vals[d_write[d]++] = vals[j];
-                }
-            } else {
-                for (i64 j = 0; j < n; ++j) {
-                    int32_t d = slot_of[j];
-                    KeyState& st = *d_state[d];
-                    i64 w = d_write[d]++;
-                    st.ts[w] = tss[j];
-                    st.vals[w] = vals[j];
-                }
-            }
-            for (std::size_t d = 0; d < nd; ++d) {
-                KeyState& st = *d_state[d];
-                d_min[d] = st.dense_base + d_write[d] - d_count[d];
-                d_max[d] = st.dense_base + d_write[d] - 1;
-            }
-        } else if (is_tb) {
-            // TB: the sort key IS the timestamp; result timestamps come
-            // from window arithmetic, so the ts column is never stored
             for (i64 j = 0; j < n; ++j) {
-                int32_t d = slot_of[j];
-                KeyState& st = *d_state[d];
-                i64 w = d_write[d]++;
-                i64 id = ids[j];
-                st.append_id(id, w, d_last[d]);
-                st.vals[w] = vals[j];
-                d_last[d] = id;
-                if (id < d_min[d]) d_min[d] = id;
-                if (id > d_max[d]) d_max[d] = id;
+                int32_t d = dense_of(bkeys[j]);
+                ++d_count[d];
+                slot_of[j] = d;
             }
         } else {
             for (i64 j = 0; j < n; ++j) {
-                int32_t d = slot_of[j];
-                KeyState& st = *d_state[d];
-                i64 w = d_write[d]++;
+                int32_t d = dense_of(bkeys[j]);
+                ++d_count[d];
+                slot_of[j] = d;
                 i64 id = ids[j];
-                st.append_id(id, w, d_last[d]);
-                st.ts[w] = tss[j];
-                st.vals[w] = vals[j];
-                d_last[d] = id;
+                if ((std::size_t)d >= d_min.size()) {
+                    d_min.resize(d + 1, INT64_MAX);
+                    d_max.resize(d + 1, INT64_MIN);
+                }
                 if (id < d_min[d]) d_min[d] = id;
                 if (id > d_max[d]) d_max[d] = id;
             }
         }
+        std::size_t nd = d_key.size();
+        if (d_min.size() < nd) d_min.resize(nd);
+        if (d_max.size() < nd) d_max.resize(nd);
+        d_accept.resize(nd);
         for (std::size_t d = 0; d < nd; ++d) {
             KeyState& st = *d_state[d];
-            if (st.max_id < 0 && d_min[d] != INT64_MAX) {
+            if (renumber) {
+                // implicit arrival-order ids: this batch appends ids
+                // [arrivals, arrivals + count)
+                d_min[d] = st.arrivals;
+                d_max[d] = st.arrivals + d_count[d] - 1;
+            }
+            if (st.max_id < 0) {
                 // first data for this key: anchor the fire frontier at
                 // the first window containing the earliest tuple --
                 // firing from 0 on an epoch-scale first id/ts would
@@ -282,40 +247,85 @@ struct Engine {
                 i64 first = d_min[d];
                 st.anchor = first < win ? 0 : (first - win) / slide + 1;
                 st.next_fire = st.anchor;
+                st.pane_base = pane_of(st.anchor * slide);
             }
-            i64 accept_from = st.next_fire > st.anchor
+            d_accept[d] = st.next_fire > st.anchor
                 ? (st.next_fire - 1) * slide + win : st.anchor * slide;
-            if (d_min[d] < accept_from) {
-                // late tuples behind the fired frontier: compact them
-                // out of the just-appended block (arrival order kept,
-                // matching the per-tuple skip of the scalar path).
-                // A dense lane can hold late tuples only via its first
-                // anchor (contiguous ids never re-enter fired ground),
-                // so materialize before compacting.
-                if (st.dense) st.materialize((i64)st.vals.size());
-                i64 base = d_write[d] - d_count[d];
-                i64 w = base;
-                for (i64 r = base; r < d_write[d]; ++r) {
-                    if (st.ids[r] >= accept_from) {
-                        st.ids[w] = st.ids[r];
-                        if (!is_tb) st.ts[w] = st.ts[r];
-                        st.vals[w] = st.vals[r];
-                        ++w;
-                    }
+            // pre-grow the ring to this batch's frontier so the fold
+            // loop never reallocates
+            i64 hi_rel = pane_of(d_max[d]) - st.pane_base;
+            if (hi_rel >= 0) ensure_pane(st, hi_rel);
+        }
+        // hopping windows (win < slide): whether an id opens a window
+        // depends on its position inside the slide period, so the
+        // opened-window frontier must be tracked per accepted tuple --
+        // the batch's final max_id alone misses windows opened by
+        // mid-batch ids when the batch ends in a gap
+        const bool hopping = win < slide;
+        if (renumber) {
+            for (i64 j = 0; j < n; ++j) {
+                int32_t d = slot_of[j];
+                KeyState& st = *d_state[d];
+                i64 id = st.arrivals++;
+                i64 p = pane_of(id) - st.pane_base;
+                if (p < 0) continue;  // hopping-gap arrival below the ring
+                if (hopping) {
+                    i64 nn = id / slide;
+                    if (id >= nn * slide + win) continue;  // gap arrival
+                    if (nn > st.opened_max) st.opened_max = nn;
                 }
-                st.ids.resize(w);
-                if (!is_tb) st.ts.resize(w);
-                st.vals.resize(w);
+                fold(st, p, (double)vals[j]);
+                if (!is_tb && id >= st.plid[p]) {
+                    st.plid[p] = id;
+                    st.plts[p] = tss[j];
+                }
             }
-            if (d_max[d] > st.max_id) st.max_id = d_max[d];
-            if (st.max_id >= 0) {
-                i64 last_w;
-                if (win >= slide) {
-                    last_w = (st.max_id + 1 + slide - 1) / slide - 1;
-                } else {
-                    i64 nn = st.max_id / slide;
-                    last_w = (st.max_id < nn * slide + win) ? nn : -1;
+        } else if (is_tb) {
+            for (i64 j = 0; j < n; ++j) {
+                int32_t d = slot_of[j];
+                i64 id = ids[j];
+                if (id < d_accept[d]) {
+                    ++ignored;
+                    continue;
                 }
+                KeyState& st = *d_state[d];
+                i64 p = pane_of(id) - st.pane_base;
+                if (p < 0) continue;  // hopping-gap tuple below the ring
+                if (hopping) {
+                    i64 nn = id / slide;
+                    if (id >= nn * slide + win) continue;  // gap tuple
+                    if (nn > st.opened_max) st.opened_max = nn;
+                }
+                fold(st, p, (double)vals[j]);
+            }
+        } else {
+            for (i64 j = 0; j < n; ++j) {
+                int32_t d = slot_of[j];
+                i64 id = ids[j];
+                if (id < d_accept[d]) {
+                    ++ignored;
+                    continue;
+                }
+                KeyState& st = *d_state[d];
+                i64 p = pane_of(id) - st.pane_base;
+                if (p < 0) continue;
+                if (hopping) {
+                    i64 nn = id / slide;
+                    if (id >= nn * slide + win) continue;  // gap tuple
+                    if (nn > st.opened_max) st.opened_max = nn;
+                }
+                fold(st, p, (double)vals[j]);
+                if (id >= st.plid[p]) {
+                    st.plid[p] = id;
+                    st.plts[p] = tss[j];
+                }
+            }
+        }
+        for (std::size_t d = 0; d < nd; ++d) {
+            KeyState& st = *d_state[d];
+            if (d_max[d] > st.max_id) st.max_id = d_max[d];
+            if (!hopping && st.max_id >= 0) {
+                i64 last_w = (st.max_id + 1 + slide - 1) / slide - 1;
                 if (last_w > st.opened_max) st.opened_max = last_w;
             }
             i64 key = d_key[d];
@@ -327,64 +337,32 @@ struct Engine {
                                      st.next_fire * slide, end});
                 ++st.next_fire;
             }
+            d_min[d] = INT64_MAX;
+            d_max[d] = INT64_MIN;
         }
     }
 
-    // one pane's partial over positions [a, b) of a key's value series,
-    // with the kind's neutral for empty panes
-    inline double pane_reduce(const KeyState& st, i64 a, i64 b) const {
-        switch (kind) {
-            case Kind::COUNT:
-                return (double)(b - a);
-            case Kind::MAX: {
-                double acc = -std::numeric_limits<double>::infinity();
-                for (i64 v = a; v < b; ++v)
-                    acc = std::max(acc, st.vals[v]);
-                return acc;
-            }
-            case Kind::MIN: {
-                double acc = std::numeric_limits<double>::infinity();
-                for (i64 v = a; v < b; ++v)
-                    acc = std::min(acc, st.vals[v]);
-                return acc;
-            }
-            case Kind::SUM:
-            default: {
-                double acc = 0.0;
-                for (i64 v = a; v < b; ++v) acc += st.vals[v];
-                return acc;
-            }
-        }
+    // pane accessors tolerant of extents beyond the retained ring
+    // (panes outside it hold no tuples by construction)
+    inline double pane_at(const KeyState& st, i64 p_abs) const {
+        i64 r = p_abs - st.pane_base;
+        return (r >= 0 && r < (i64)st.pacc.size()) ? st.pacc[r] : neutral;
+    }
+    inline i64 cnt_at(const KeyState& st, i64 p_abs) const {
+        i64 r = p_abs - st.pane_base;
+        return (r >= 0 && r < (i64)st.pcnt.size()) ? st.pcnt[r] : 0;
     }
 
-    void sort_key(KeyState& st) {
-        if (st.dense || !st.needs_sort) return;
-        std::vector<std::size_t> idx(st.ids.size());
-        std::iota(idx.begin(), idx.end(), 0);
-        std::stable_sort(idx.begin(), idx.end(), [&](auto a, auto b) {
-            return st.ids[a] < st.ids[b];
-        });
-        std::vector<i64> ids2(st.ids.size());
-        std::vector<double> v2(st.ids.size());
-        for (std::size_t j = 0; j < idx.size(); ++j) {
-            ids2[j] = st.ids[idx[j]];
-            v2[j] = st.vals[idx[j]];
-        }
-        st.ids.swap(ids2);
-        st.vals.swap(v2);
-        if (!st.ts.empty()) {
-            std::vector<i64> ts2(st.ids.size());
-            for (std::size_t j = 0; j < idx.size(); ++j)
-                ts2[j] = st.ts[idx[j]];
-            st.ts.swap(ts2);
-        }
-        st.needs_sort = false;
-    }
+    struct SpanInfo {
+        i64 off, base_key;
+        std::vector<i64> prefix;  // prefix tuple counts over the span
+    };
 
-    // Stage up to max_windows ready windows as pane partial sums.
+    // Stage up to max_windows ready windows as pane partials.
     // Returns the number staged.
     i64 flush(i64 max_windows) {
         st_vals.clear();
+        st_cnts.clear();
         st_starts.clear();
         st_ends.clear();
         st_keys.clear();
@@ -405,78 +383,64 @@ struct Engine {
                 it->second.second = std::max(it->second.second, ds.end);
             }
         }
-        std::unordered_map<i64, std::pair<i64, i64>> base;  // key->off,base
+        std::unordered_map<i64, SpanInfo> info;
         for (auto& [key, mm] : span) {
             KeyState& st = keys[key];
-            sort_key(st);
             i64 base_key = mm.first, max_end = mm.second;
+            i64 p0 = pane_of(base_key);
             i64 n_panes = (max_end - base_key) / pane;
-            i64 off = (i64)st_vals.size();
-            base[key] = {off, base_key};
-            if (st.dense) {
-                // pane edges are pure position arithmetic on the dense
-                // lane
-                for (i64 p = 0; p < n_panes; ++p) {
-                    i64 a = st.pos_of(base_key + p * pane);
-                    i64 b = st.pos_of(base_key + (p + 1) * pane);
-                    st_vals.push_back(pane_reduce(st, a, b));
-                }
-            } else {
-                // pane partials via binary-searched edges
-                auto lo_it = st.ids.begin();
-                for (i64 p = 0; p < n_panes; ++p) {
-                    i64 lo_key = base_key + p * pane;
-                    i64 hi_key = lo_key + pane;
-                    auto a = std::lower_bound(lo_it, st.ids.end(), lo_key);
-                    auto b = std::lower_bound(a, st.ids.end(), hi_key);
-                    st_vals.push_back(pane_reduce(
-                        st, a - st.ids.begin(), b - st.ids.begin()));
-                    lo_it = b;
-                }
+            SpanInfo si;
+            si.off = (i64)st_vals.size();
+            si.base_key = base_key;
+            si.prefix.resize(n_panes + 1);
+            si.prefix[0] = 0;
+            for (i64 p = 0; p < n_panes; ++p) {
+                st_vals.push_back(pane_at(st, p0 + p));
+                si.prefix[p + 1] = si.prefix[p] + cnt_at(st, p0 + p);
+                if (kind == Kind::MEAN)
+                    st_cnts.push_back((double)cnt_at(st, p0 + p));
             }
+            info.emplace(key, std::move(si));
         }
         for (i64 d = 0; d < take; ++d) {
             const Desc& ds = ready[d];
-            auto [off, base_key] = base[ds.key];
+            const SpanInfo& si = info[ds.key];
             st_keys.push_back(ds.key);
             st_gwids.push_back(ds.lwid);
-            // tuple extent of the window: a window with zero tuples in
-            // a gapped id space must stage an EMPTY pane range
-            // (start==end) so the device combine emits the masked
-            // neutral 0, exactly like the Python/XLA path
-            // (window_compute.py's `jnp.where(valid, out, 0)`) --
+            i64 ps = (ds.start - si.base_key) / pane;
+            i64 pe = (ds.end - si.base_key) / pane;
+            // a fired window whose extent holds no tuples (gapped id
+            // space) stages an EMPTY pane range (start==end) so the
+            // device combine emits the masked neutral 0, exactly like
+            // the Python/XLA path (window_compute.py `jnp.where`) --
             // otherwise max/min kinds would emit the +-inf pane fill
-            KeyState& st = keys[ds.key];
-            i64 lo, hi;
-            if (st.dense) {
-                lo = st.pos_of(ds.start);
-                hi = st.pos_of(ds.end);
-            } else {
-                auto a = std::lower_bound(st.ids.begin(), st.ids.end(),
-                                          ds.start);
-                auto b = std::lower_bound(a, st.ids.end(), ds.end);
-                lo = a - st.ids.begin();
-                hi = b - st.ids.begin();
-            }
-            if (hi > lo) {
-                st_starts.push_back(off + (ds.start - base_key) / pane);
-                st_ends.push_back(off + (ds.end - base_key) / pane);
-            } else {
-                st_starts.push_back(off);
-                st_ends.push_back(off);
-            }
+            bool empty = si.prefix[pe] == si.prefix[ps];
+            st_starts.push_back(si.off + (empty ? 0 : ps));
+            st_ends.push_back(si.off + (empty ? 0 : pe));
             if (is_tb) {
                 st_rts.push_back(ds.lwid * slide + win - 1);
+            } else if (empty) {
+                st_rts.push_back(0);
             } else {
-                // CB: result timestamp = ts of the last tuple in the
-                // window extent (matches the host engine / reference)
-                st_rts.push_back(hi > lo ? st.ts[hi - 1] : 0);
+                // CB: result ts = ts of the max-id tuple in the extent,
+                // which lives in the last non-empty pane of the range
+                // (binary search on the span's count prefix)
+                const auto& pf = si.prefix;
+                i64 q = std::lower_bound(pf.begin() + ps,
+                                         pf.begin() + pe + 1,
+                                         pf[pe]) - pf.begin();
+                KeyState& st = keys[ds.key];
+                i64 p_abs = pane_of(si.base_key) + (q - 1);
+                i64 r = p_abs - st.pane_base;
+                st_rts.push_back(
+                    (r >= 0 && r < (i64)st.plts.size()) ? st.plts[r] : 0);
             }
         }
         ready.erase(ready.begin(), ready.begin() + take);
-        // evict consumed prefixes -- but never past the earliest window
-        // still queued in `ready` for the key (a partial take leaves
-        // fired-but-unstaged windows whose extents must stay resident)
+        // evict consumed pane prefixes -- but never past the earliest
+        // window still queued in `ready` for the key (a partial take
+        // leaves fired-but-unstaged windows whose extents must stay
+        // resident)
         std::unordered_map<i64, i64> queued_floor;
         for (const Desc& ds : ready) {
             auto it = queued_floor.find(ds.key);
@@ -489,24 +453,17 @@ struct Engine {
             auto qf = queued_floor.find(key);
             if (qf != queued_floor.end() && qf->second < keep_from)
                 keep_from = qf->second;
-            i64 cut;
-            if (st.dense) {
-                cut = keep_from - st.dense_base;
-                i64 sz = (i64)st.vals.size();
-                if (cut < 0) cut = 0;
-                if (cut > sz) cut = sz;
-                st.dense_base += cut;
-            } else {
-                cut = std::lower_bound(st.ids.begin(), st.ids.end(),
-                                       keep_from) - st.ids.begin();
-                if (cut > 0)
-                    st.ids.erase(st.ids.begin(), st.ids.begin() + cut);
+            i64 cut = pane_of(keep_from) - st.pane_base;
+            i64 sz = (i64)st.pacc.size();
+            if (cut <= 0) continue;
+            if (cut > sz) cut = sz;
+            st.pacc.erase(st.pacc.begin(), st.pacc.begin() + cut);
+            st.pcnt.erase(st.pcnt.begin(), st.pcnt.begin() + cut);
+            if (!is_tb) {
+                st.plid.erase(st.plid.begin(), st.plid.begin() + cut);
+                st.plts.erase(st.plts.begin(), st.plts.begin() + cut);
             }
-            if (cut > 0) {
-                if (!is_tb)
-                    st.ts.erase(st.ts.begin(), st.ts.begin() + cut);
-                st.vals.erase(st.vals.begin(), st.vals.begin() + cut);
-            }
+            st.pane_base += cut;
         }
         return take;
     }
@@ -523,11 +480,11 @@ struct Engine {
     }
 
     // -- checkpoint / resume ------------------------------------------
-    // Versioned binary snapshot of all mutable state (per-key series +
-    // fired-but-unstaged descriptors).  The reference has no
+    // Versioned binary snapshot of all mutable state (per-key pane
+    // rings + fired-but-unstaged descriptors).  The reference has no
     // checkpointing at all (SURVEY.md §5); this feeds the policy layer
     // in utils/checkpoint.py through the Python state_dict hooks.
-    static constexpr i64 SNAP_MAGIC = 0x32'4E'46'57;  // "WFN2"
+    static constexpr i64 SNAP_MAGIC = 0x33'4E'46'57;  // "WFN3"
 
     template <typename T>
     static void put(std::vector<unsigned char>& b, const T& v) {
@@ -576,12 +533,11 @@ struct Engine {
             put(b, key);
             put(b, st.next_fire); put(b, st.anchor);
             put(b, st.opened_max); put(b, st.max_id);
-            put(b, (i64)((st.dense ? 1 : 0) | (st.base_set ? 2 : 0)
-                         | (st.needs_sort ? 4 : 0)));
-            put(b, st.dense_base);
-            put_vec(b, st.ids);
-            put_vec(b, st.ts);
-            put_vec(b, st.vals);
+            put(b, st.pane_base); put(b, st.arrivals);
+            put_vec(b, st.pacc);
+            put_vec(b, st.pcnt);
+            put_vec(b, st.plid);
+            put_vec(b, st.plts);
         }
         put(b, (i64)ready.size());
         for (const Desc& d : ready) {
@@ -606,18 +562,23 @@ struct Engine {
         keys.clear();
         ready.clear();
         for (i64 i = 0; i < nk; ++i) {
-            i64 key, flags;
+            i64 key;
             KeyState st;
             if (!get(p, end, key) || !get(p, end, st.next_fire)
                 || !get(p, end, st.anchor)
                 || !get(p, end, st.opened_max) || !get(p, end, st.max_id)
-                || !get(p, end, flags) || !get(p, end, st.dense_base)
-                || !get_vec(p, end, st.ids) || !get_vec(p, end, st.ts)
-                || !get_vec(p, end, st.vals))
+                || !get(p, end, st.pane_base) || !get(p, end, st.arrivals)
+                || !get_vec(p, end, st.pacc) || !get_vec(p, end, st.pcnt)
+                || !get_vec(p, end, st.plid) || !get_vec(p, end, st.plts))
                 return false;
-            st.dense = flags & 1;
-            st.base_set = flags & 2;
-            st.needs_sort = flags & 4;
+            if (st.pcnt.size() != st.pacc.size()
+                || st.plid.size() != st.plts.size())
+                return false;
+            // CB engines index plid/plts in lockstep with pacc on every
+            // ingest; a snapshot with short ts-lane vectors would pass
+            // the pairwise checks above and then write out of bounds
+            if (!is_tb && st.plid.size() != st.pacc.size())
+                return false;
             keys.emplace(key, std::move(st));
         }
         i64 nr;
@@ -670,17 +631,25 @@ i64 wfn_engine_ready(void* ep) {
     return (i64)static_cast<Engine*>(ep)->ready.size();
 }
 
+i64 wfn_engine_ignored(void* ep) {
+    return static_cast<Engine*>(ep)->ignored;
+}
+
 void wfn_engine_eos(void* ep) { static_cast<Engine*>(ep)->eos(); }
 
 // Stage up to max_windows; returns B staged.  Pointers are valid until
-// the next flush call.
+// the next flush call.  `cnts` carries per-pane tuple counts (same
+// layout as vals) for the MEAN kind and is empty otherwise.
 i64 wfn_engine_flush(void* ep, i64 max_windows, double** vals, i64* n_vals,
+                     double** cnts, i64* n_cnts,
                      i64** starts, i64** ends, i64** keys, i64** gwids,
                      i64** rts) {
     Engine& e = *static_cast<Engine*>(ep);
     i64 b = e.flush(max_windows);
     *vals = e.st_vals.data();
     *n_vals = (i64)e.st_vals.size();
+    *cnts = e.st_cnts.data();
+    *n_cnts = (i64)e.st_cnts.size();
     *starts = e.st_starts.data();
     *ends = e.st_ends.data();
     *keys = e.st_keys.data();

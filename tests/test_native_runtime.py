@@ -217,6 +217,31 @@ def test_engine_gapped_window_stages_empty_extent():
         assert got[0] == full and got[3] == full, (kind, got)
 
 
+def test_engine_renumber_hopping_gap_after_eviction():
+    """Renumber lane + hopping windows (win < slide): after a flush
+    evicts up to next_fire*slide, subsequent arrivals land BELOW the
+    pane ring base (they belong to no window) and must be skipped, not
+    folded at a negative ring index (window_engine.cpp ingest)."""
+    from windflow_tpu.runtime.native import NativeWindowEngine
+
+    eng = NativeWindowEngine(2, 10, False, 0, renumber=True)
+    ids = np.arange(4, dtype=np.int64)
+    eng.ingest(np.zeros(4, np.int64), ids, ids, np.ones(4))
+    assert eng.ready() == 1  # window 0 = arrivals [0, 2)
+    out = eng.flush(10)      # evicts panes below next_fire*slide = 10
+    assert out is not None and len(out[4]) == 1
+    # arrivals 4..9 sit in the gap below the evicted frontier; 10..11
+    # fill window 1 exactly
+    n = 8
+    ids2 = np.arange(4, 4 + n, dtype=np.int64)
+    eng.ingest(np.zeros(n, np.int64), ids2, ids2, np.full(n, 3.0))
+    eng.eos()
+    out = eng.flush(10)
+    vals, starts, ends, _keys, gwids, _rts = out[:6]
+    assert list(gwids) == [1]
+    assert vals[starts[0]:ends[0]].sum() == 6.0  # arrivals 10, 11 only
+
+
 def test_engine_deserialize_rejects_huge_length_field():
     """A corrupted checkpoint blob with an enormous vector-length field
     must fail cleanly, not overflow the bounds check into a multi-GB
@@ -228,22 +253,23 @@ def test_engine_deserialize_rejects_huge_length_field():
               np.arange(10, dtype=np.int64), np.ones(10))
     blob = bytearray(e1.serialize())
     import struct
-    # parse the snapshot framing (window_engine.cpp serialize()): the
-    # 8-i64 header (magic,win,slide,delay,tb,rn,kind,nkeys) and the
+    # parse the WFN3 snapshot framing (window_engine.cpp serialize()):
+    # the 8-i64 header (magic,win,slide,delay,tb,rn,kind,nkeys) and the
     # first key's 7 fixed i64s (key,next_fire,anchor,opened_max,max_id,
-    # flags,dense_base), then walk the three per-key vectors (ids, ts,
-    # vals) by their length headers and corrupt the first non-empty one
+    # pane_base,arrivals), then walk the four per-key vectors
+    # (pacc,pcnt,plid,plts) by their length headers and corrupt the
+    # first non-empty one
     off = 8 * 8 + 7 * 8
     corrupted = False
-    for _ in range(3):
+    for _ in range(4):
         n = struct.unpack_from("<q", blob, off)[0]
-        assert 0 <= n <= 10  # framing sanity: a plausible vector length
+        assert 0 <= n <= 32  # framing sanity: a plausible ring length
         if n > 0:
             struct.pack_into("<q", blob, off, 1 << 61)
             corrupted = True
             break
         off += 8 + n * 8
-    assert corrupted  # 10 staged values: some vector must be non-empty
+    assert corrupted  # 10 ingested values: the pane ring is non-empty
     e2 = NativeWindowEngine(32, 16, True)
     with pytest.raises(ValueError):
         e2.deserialize(bytes(blob))

@@ -108,13 +108,14 @@ class _AsyncDispatcher:
         last_emit = None
         while True:
             try:
-                item = self.work.get(timeout=0.25)
+                # fine-grained poll while batches are in flight: their
+                # async D2H lands mid-stream and must be emitted then,
+                # not at the next launch (latency would otherwise grow
+                # with the launch interval)
+                item = self.work.get(timeout=0.005 if pending else 0.25)
             except _q.Empty:
                 if self.aborting:
                     return
-                # idle stream: drain whatever already completed so a
-                # stalled-but-unterminated source doesn't withhold
-                # results until the pipeline refills to depth
                 while (pending and self.error is None
                        and not self.aborting and pending[0][0].ready()):
                     try:
@@ -132,8 +133,13 @@ class _AsyncDispatcher:
                 handle = engine.compute(cols, starts, ends, gwids)
                 logic.launched_batches += 1
                 pending.append((handle, descs, birth))
-                while (len(pending) >= logic.inflight_depth
-                       and not self.aborting):
+                # flush at depth (backpressure) AND any batch whose
+                # async D2H already landed -- otherwise results wait
+                # for the pipeline to fill and latency grows with
+                # inflight_depth instead of shrinking
+                while (pending and not self.aborting
+                       and (len(pending) >= logic.inflight_depth
+                            or pending[0][0].ready())):
                     logic._finish(pending.popleft(), emit)
             except BaseException as e:  # surfaced on next submit / drain
                 self.error = e
@@ -177,7 +183,8 @@ class WinSeqTPULogic(NodeLogic):
                  value_of: Callable[[Any], float] = None,
                  closing_func: Callable = None, emit_batches: bool = False,
                  max_buffer_elems: int = 1 << 19, inflight_depth: int = 4,
-                 async_dispatch: bool = True):
+                 async_dispatch: bool = True,
+                 max_batch_delay_ms: float = 10.0):
         if win_len == 0 or slide_len == 0:
             raise ValueError("win_len and slide_len must be > 0")
         self.engine = WindowComputeEngine(win_kind)
@@ -215,6 +222,14 @@ class WinSeqTPULogic(NodeLogic):
         # win_seq_gpu.hpp:574-592)
         self.max_buffer_elems = max_buffer_elems
         self._buffered_since_launch = 0
+        # time-based launch trigger: a partial batch launches whenever
+        # windows are ready and at least this long has passed since the
+        # previous launch -- the latency half of the reference's
+        # adaptive batch resize (win_seq_gpu.hpp:574-592), bounding
+        # result latency at (delay + transport RTT) instead of
+        # (full-batch fill time + RTT)
+        self.max_batch_delay_ms = max_batch_delay_ms
+        self._last_launch_t = 0.0
         # window-result latency samples (descriptor creation -> emission),
         # feeding the p99 metric of BASELINE.md
         self.latency_samples: List[float] = []
@@ -225,7 +240,7 @@ class WinSeqTPULogic(NodeLogic):
         self._native = None
         cfg = self.config
         if (isinstance(win_kind, str)
-                and win_kind in ("sum", "count", "max", "min")
+                and win_kind in ("sum", "count", "max", "min", "mean")
                 and role == Role.SEQ
                 and cfg.n_outer == 1 and cfg.n_inner == 1
                 and cfg.id_outer == 0 and cfg.id_inner == 0
@@ -328,13 +343,16 @@ class WinSeqTPULogic(NodeLogic):
             self.launched_batches += 1
             self.pending.append((handle, descs, birth))
         self._buffered_since_launch = 0
+        import time as _time
+        self._last_launch_t = _time.perf_counter()
 
     def _flush_pending(self, emit, drain: bool = False) -> None:
         """Emit completed in-flight batches: the oldest when the
-        pipeline is at depth (waitAndFlush), or all when draining
-        (inline-dispatch mode only)."""
+        pipeline is at depth (waitAndFlush), any whose async D2H has
+        landed, or all when draining (inline-dispatch mode only)."""
         while self.pending and (drain
-                                or len(self.pending) >= self.inflight_depth):
+                                or len(self.pending) >= self.inflight_depth
+                                or self.pending[0][0].ready()):
             self._finish(self.pending.popleft(), emit)
 
     def _drain_all(self, emit) -> None:
@@ -532,16 +550,36 @@ class WinSeqTPULogic(NodeLogic):
         out = self._native.flush(max_windows or max(self.batch_len, 4096))
         if out is None:
             return
-        vals, starts, ends, d_keys, d_gwids, d_rts = out
+        vals, starts, ends, d_keys, d_gwids, d_rts = out[:6]
         import time as _time
         birth = self._batch_birth or _time.perf_counter()
-        self._batch_birth = None
-        # count windows sum their per-pane counts; max/min fold partials
-        # through the matching sparse-table engine (self.engine)
-        eng = self._count_engine() if self.engine.kind == "count" else None
-        self._submit({"value": vals}, starts, ends, d_gwids,
+        # leftover ready windows (partial flush) restart the age clock
+        self._batch_birth = (_time.perf_counter() if self._native.ready()
+                             else None)
+        cols = {"value": vals}
+        # count windows sum their per-pane counts; mean windows divide
+        # pane-sum totals by pane-count totals (pair program); max/min
+        # fold partials through the matching sparse-table engine
+        if self.engine.kind == "count":
+            eng = self._count_engine()
+        elif self.engine.kind == "mean":
+            cols["count"] = out[6]
+            eng = self._mean_engine()
+        else:
+            eng = None
+        self._submit(cols, starts, ends, d_gwids,
                      ("native", d_keys, d_gwids, d_rts), birth, emit,
                      engine=eng)
+
+    def _mean_engine(self):
+        if not hasattr(self, "_mean_eng"):
+            self._mean_eng = WindowComputeEngine("mean_panes")
+        return self._mean_eng
+
+    def _launch_due(self) -> bool:
+        import time as _time
+        return ((_time.perf_counter() - self._last_launch_t) * 1e3
+                >= self.max_batch_delay_ms)
 
     def _svc_batch_native(self, batch: TupleBatch, emit):
         import time as _time
@@ -551,9 +589,9 @@ class WinSeqTPULogic(NodeLogic):
         if ready and self._batch_birth is None:
             self._batch_birth = _time.perf_counter()
         self._buffered_since_launch += len(batch)
-        if ready >= self.batch_len or (
-                ready and self._buffered_since_launch
-                >= self.max_buffer_elems):
+        if ready and (ready >= self.batch_len
+                      or self._buffered_since_launch >= self.max_buffer_elems
+                      or self._launch_due()):
             self._native_launch(emit)
 
     def _svc_batch(self, batch: TupleBatch, emit):
@@ -613,7 +651,8 @@ class WinSeqTPULogic(NodeLogic):
                 st.opened_max = max(st.opened_max, last_w)
             self._fire_ready(key, st, st.max_id, hashcode, emit)
         if (self.descriptors
-                and self._buffered_since_launch >= self.max_buffer_elems):
+                and (self._buffered_since_launch >= self.max_buffer_elems
+                     or self._launch_due())):
             self._launch(emit)
 
     def svc(self, item, channel_id, emit):
@@ -662,6 +701,8 @@ class WinSeqTPULogic(NodeLogic):
             st.pending_val.append(self.value_of(t))
         st.max_id = max(st.max_id, id_)
         self._fire_ready(key, st, id_, hashcode, emit)
+        if self.descriptors and self._launch_due():
+            self._launch(emit)
 
     def eos_flush(self, emit):
         """Fire every opened window, then drain both batches (the
@@ -749,7 +790,7 @@ class WinSeqTPU(Operator):
                  name="win_seq_tpu", result_factory=BasicRecord,
                  value_of=None, closing_func=None, emit_batches=False,
                  max_buffer_elems=1 << 19, inflight_depth=4,
-                 async_dispatch=True):
+                 async_dispatch=True, max_batch_delay_ms=10.0):
         super().__init__(name, 1, RoutingMode.FORWARD, Pattern.WIN_SEQ_TPU)
         self.win_type = win_type
         self.kwargs = dict(
@@ -758,7 +799,8 @@ class WinSeqTPU(Operator):
             triggering_delay=triggering_delay, result_factory=result_factory,
             value_of=value_of, closing_func=closing_func,
             emit_batches=emit_batches, max_buffer_elems=max_buffer_elems,
-            inflight_depth=inflight_depth, async_dispatch=async_dispatch)
+            inflight_depth=inflight_depth, async_dispatch=async_dispatch,
+            max_batch_delay_ms=max_batch_delay_ms)
         self._renumbering = False
 
     def enable_renumbering(self):

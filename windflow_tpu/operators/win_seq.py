@@ -206,14 +206,18 @@ class WinSeqLogic(NodeLogic):
 
 def builtin_win_func(kind: str):
     """Non-incremental window function for a builtin aggregate name
-    (sum/count/max/min).  Empty windows produce the masked neutral 0,
-    matching the columnar/native planes (window_compute.py)."""
+    (sum/count/mean/max/min).  Empty windows produce the masked neutral
+    0, matching the columnar/native planes (window_compute.py)."""
     if kind == "sum":
         def f(gwid, it, res):
             res.value = sum(t.value for t in it)
     elif kind == "count":
         def f(gwid, it, res):
             res.value = float(len(it))
+    elif kind == "mean":
+        def f(gwid, it, res):
+            res.value = (sum(t.value for t in it) / len(it)
+                         if len(it) else 0.0)
     elif kind == "max":
         def f(gwid, it, res):
             res.value = max((t.value for t in it), default=0.0)

@@ -35,12 +35,15 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
-# One in-flight dispatch at a time: some PJRT transports (notably the
-# remote-relay backend used under test) are not robust to a thundering
-# herd of device_put calls from many host threads.
-_DISPATCH_LOCK = threading.Lock()
-
 BUILTIN_KINDS = ("sum", "count", "mean", "max", "min")
+
+# pane-partial pair kinds: cols carry a second buffer alongside "value"
+# (the native engine's MEAN staging ships per-pane sums + counts)
+PAIR_KINDS = ("mean_panes",)
+
+# opt-in escape hatch for transports that cannot take concurrent
+# transfers (WINDFLOW_GLOBAL_DISPATCH_LOCK=1)
+_GLOBAL_DISPATCH_LOCK = threading.Lock()
 
 
 def next_pow2(n: int) -> int:
@@ -82,6 +85,73 @@ def _scan_program(kind: str):
         else:  # mean
             out = s / jnp.maximum(n, 1)
         return out
+
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def _tile_sum_program(w_pad: int):
+    """Window sums via a masked [B, w_pad] gather-tile reduction.
+    Used instead of the prefix scan when every window spans few panes:
+    the scan's c[end]-c[start] differencing carries the f32 rounding of
+    the WHOLE buffer's magnitude into each window (catastrophic for
+    small windows late in the buffer), while the tile sums only the
+    window's own panes -- exact to within-window rounding, and for
+    w_pad this small the gather is cheaper than the scan anyway."""
+    jax, jnp = _jax()
+
+    @jax.jit
+    def run(values, se):
+        starts, ends = se[0], se[1]
+        T = values.shape[0]
+        idx = starts[:, None] + jnp.arange(w_pad)[None, :]
+        mask = idx < ends[:, None]
+        idx = jnp.clip(idx, 0, T - 1)
+        return jnp.where(mask, values[idx], 0).sum(axis=1)
+
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def _tile_mean_program(w_pad: int):
+    jax, jnp = _jax()
+
+    @jax.jit
+    def run(values, counts, se):
+        starts, ends = se[0], se[1]
+        T = values.shape[0]
+        idx = starts[:, None] + jnp.arange(w_pad)[None, :]
+        mask = idx < ends[:, None]
+        idx = jnp.clip(idx, 0, T - 1)
+        s = jnp.where(mask, values[idx], 0).sum(axis=1)
+        n = jnp.where(mask, counts[idx], 0).sum(axis=1)
+        return s / jnp.maximum(n, 1)
+
+    return run
+
+
+# max pane extent (already padded to a power of two) served by the
+# gather-tile programs; wider windows take the prefix scan
+_TILE_MAX_W = 32
+
+
+@functools.lru_cache(maxsize=None)
+def _scan_pair_program():
+    """Mean over pane partials: per-window sum of pane sums divided by
+    sum of pane counts (the native engine's MEAN staging ships both
+    buffers; a windowed mean is NOT the mean of pane means)."""
+    jax, jnp = _jax()
+
+    @jax.jit
+    def run(values, counts, se):
+        starts, ends = se[0], se[1]
+        cv = jnp.concatenate([jnp.zeros((1,), values.dtype),
+                              jnp.cumsum(values)])
+        cc = jnp.concatenate([jnp.zeros((1,), counts.dtype),
+                              jnp.cumsum(counts)])
+        s = cv[ends] - cv[starts]
+        n = cc[ends] - cc[starts]
+        return s / jnp.maximum(n, 1)
 
     return run
 
@@ -238,8 +308,7 @@ class DeviceBatchHandle:
             return False
 
     def block(self) -> np.ndarray:
-        with _DISPATCH_LOCK:
-            return np.asarray(self._dev)[: self._n]
+        return np.asarray(self._dev)[: self._n]
 
 
 class WindowComputeEngine:
@@ -256,17 +325,29 @@ class WindowComputeEngine:
         # tree over the flat buffer (Win_SeqFFAT_GPU analogue)
         is_ffat = isinstance(kind, tuple) and len(kind) == 3 \
             and kind[0] == "ffat"
-        if not (callable(kind) or kind in BUILTIN_KINDS or is_ffat):
+        if not (callable(kind) or kind in BUILTIN_KINDS
+                or kind in PAIR_KINDS or is_ffat):
             raise ValueError(f"unknown window combine kind: {kind!r}")
         self.kind = kind
         self.is_ffat = is_ffat
         self.value_col = value_col
         self.dtype = dtype
+        # one in-flight dispatch per ENGINE (scoped from the old
+        # process-global lock so farm replicas overlap launches --
+        # measured safe on the axon relay: 8 concurrent device_puts
+        # complete without error and overlap to ~4x throughput).  For a
+        # transport that cannot take concurrent transfers, the env var
+        # restores process-global serialization.
+        import os
+        if os.environ.get("WINDFLOW_GLOBAL_DISPATCH_LOCK") == "1":
+            self._lock = _GLOBAL_DISPATCH_LOCK
+        else:
+            self._lock = threading.Lock()
 
     def compute(self, cols: Dict[str, np.ndarray], starts: np.ndarray,
                 ends: np.ndarray, gwids: np.ndarray) -> DeviceBatchHandle:
         """Launch one batch; returns an async handle."""
-        with _DISPATCH_LOCK:
+        with self._lock:
             return self._compute(cols, starts, ends, gwids)
 
     def _compute(self, cols: Dict[str, np.ndarray], starts: np.ndarray,
@@ -274,8 +355,12 @@ class WindowComputeEngine:
         import jax.numpy as jnp
         B = len(starts)
         T = len(next(iter(cols.values())))
-        T_pad = next_pow2(T)
-        B_pad = next_pow2(B)
+        # floor the shape buckets: padding a small launch to 2048 costs
+        # ~16-32 KB of transfer (noise next to the transport RTT) and
+        # collapses the set of distinct compiled programs to a handful,
+        # so steady-state launches never hit a mid-stream XLA compile
+        T_pad = next_pow2(max(T, 2048))
+        B_pad = next_pow2(max(B, 2048))
         # starts/ends ride in ONE packed int32 array: over a high-latency
         # PJRT transport every device_put is a round trip, so the builtin
         # paths ship exactly two buffers (values + extents) per launch
@@ -318,6 +403,13 @@ class WindowComputeEngine:
             prog = _custom_program(self.kind, w_pad, names)
             dev = prog(jnp.asarray(gwids_p), jnp.asarray(se[0]),
                        jnp.asarray(se[1]), jnp.asarray(valid), *padded)
+        elif self.kind == "mean_panes":
+            wp = next_pow2(max(int((ends - starts).max()) if B else 1, 2))
+            prog = (_tile_mean_program(wp) if wp <= _TILE_MAX_W
+                    else _scan_pair_program())
+            dev = prog(jnp.asarray(pad_col(cols[self.value_col])),
+                       jnp.asarray(pad_col(cols["count"])),
+                       jnp.asarray(se))
         elif self.kind in ("max", "min"):
             fill = -np.inf if self.kind == "max" else np.inf
             n_levels = max(1, int(np.log2(T_pad)) + 1)
@@ -325,7 +417,10 @@ class WindowComputeEngine:
             dev = prog(jnp.asarray(pad_col(cols[self.value_col], fill)),
                        jnp.asarray(se))
         else:
-            prog = _scan_program(self.kind)
+            wp = next_pow2(max(int((ends - starts).max()) if B else 1, 2))
+            prog = (_tile_sum_program(wp)
+                    if self.kind == "sum" and wp <= _TILE_MAX_W
+                    else _scan_program(self.kind))
             dev = prog(jnp.asarray(pad_col(cols[self.value_col])),
                        jnp.asarray(se))
         return DeviceBatchHandle(dev, B)

@@ -107,10 +107,13 @@ def get_lib():
             ctypes.POINTER(ctypes.c_float), LL]
         lib.wfn_engine_ready.restype = LL
         lib.wfn_engine_ready.argtypes = [ctypes.c_void_p]
+        lib.wfn_engine_ignored.restype = LL
+        lib.wfn_engine_ignored.argtypes = [ctypes.c_void_p]
         lib.wfn_engine_eos.argtypes = [ctypes.c_void_p]
         lib.wfn_engine_flush.restype = LL
         lib.wfn_engine_flush.argtypes = [
             ctypes.c_void_p, LL, ctypes.POINTER(PD), PLL,
+            ctypes.POINTER(PD), PLL,
             ctypes.POINTER(PLL), ctypes.POINTER(PLL), ctypes.POINTER(PLL),
             ctypes.POINTER(PLL), ctypes.POINTER(PLL)]
         lib.wfn_engine_serialize.restype = LL
@@ -251,7 +254,7 @@ class NativeRecordPipeline:
     __slots__ = ("lib", "ptr", "_started", "_waited", "_store")
 
     FIELDS = {"key": 0, "id": 1, "ts": 2, "value": 3}
-    WKINDS = {"sum": 0, "count": 1, "max": 2, "min": 3}
+    WKINDS = {"sum": 0, "count": 1, "max": 2, "min": 3, "mean": 4}
     _FILTER_OPS = {"mod_eq": 0, "lt": 1, "gt": 2, "le": 3, "ge": 4, "eq": 5}
 
     def __init__(self, mode: str = "fused", shards: int = 1,
@@ -384,7 +387,7 @@ class NativeWindowEngine:
 
     __slots__ = ("lib", "ptr")
 
-    KINDS = {"sum": 0, "count": 1, "max": 2, "min": 3}
+    KINDS = {"sum": 0, "count": 1, "max": 2, "min": 3, "mean": 4}
 
     def __init__(self, win_len: int, slide_len: int, is_tb: bool,
                  delay: int = 0, renumber: bool = False, kind: str = "sum"):
@@ -424,21 +427,30 @@ class NativeWindowEngine:
     def ready(self) -> int:
         return self.lib.wfn_engine_ready(self.ptr)
 
+    def ignored(self) -> int:
+        """Tuples dropped behind the fired frontier (the acceptance
+        rule of win_seq.hpp:417-428)."""
+        return self.lib.wfn_engine_ignored(self.ptr)
+
     def eos(self) -> None:
         self.lib.wfn_engine_eos(self.ptr)
 
     def flush(self, max_windows: int):
-        """Returns (vals[f64], starts, ends, keys, gwids, rts) numpy
-        copies, or None when nothing is ready."""
+        """Returns (vals[f64], starts, ends, keys, gwids, rts[, cnts])
+        numpy copies, or None when nothing is ready.  ``cnts`` (per-pane
+        tuple counts, same layout as vals) is appended only for the
+        'mean' kind."""
         import numpy as np
         LL = ctypes.c_longlong
         PD = ctypes.POINTER(ctypes.c_double)
         PLL = ctypes.POINTER(LL)
         vals_p, n_vals = PD(), LL()
+        cnts_p, n_cnts = PD(), LL()
         sp, ep, kp, gp, rp = PLL(), PLL(), PLL(), PLL(), PLL()
         b = self.lib.wfn_engine_flush(
             self.ptr, max_windows, ctypes.byref(vals_p),
-            ctypes.byref(n_vals), ctypes.byref(sp), ctypes.byref(ep),
+            ctypes.byref(n_vals), ctypes.byref(cnts_p),
+            ctypes.byref(n_cnts), ctypes.byref(sp), ctypes.byref(ep),
             ctypes.byref(kp), ctypes.byref(gp), ctypes.byref(rp))
         if b == 0:
             return None
@@ -447,9 +459,12 @@ class NativeWindowEngine:
         def arr(p, n, dt):
             return np.ctypeslib.as_array(p, shape=(n,)).astype(dt, copy=True)
 
-        return (arr(vals_p, nv, np.float64), arr(sp, b, np.int64),
-                arr(ep, b, np.int64), arr(kp, b, np.int64),
-                arr(gp, b, np.int64), arr(rp, b, np.int64))
+        out = (arr(vals_p, nv, np.float64), arr(sp, b, np.int64),
+               arr(ep, b, np.int64), arr(kp, b, np.int64),
+               arr(gp, b, np.int64), arr(rp, b, np.int64))
+        if n_cnts.value:
+            out = out + (arr(cnts_p, n_cnts.value, np.float64),)
+        return out
 
     def serialize(self) -> bytes:
         """Versioned binary snapshot of all mutable engine state."""

@@ -123,12 +123,14 @@ class KSlackLogic(NodeLogic):
         self.ts_sample: List[int] = []   # delays sampled since last advance
         self.last_timestamp = 0
         self.dropped = 0
-        # control fields of every dropped record, for exact accounting
+        # control fields of dropped records, for exact accounting
         # oracles (each source tuple is either emitted in-order exactly
-        # once or appears here): the reference only counts
-        # (kslack_node.hpp dropped_inputs); keeping identities costs
-        # nothing at streaming scale relative to the sort buffer
+        # once or appears here).  The reference only counts
+        # (kslack_node.hpp dropped_inputs); identities are retained up
+        # to a cap so a long-running lossy stream cannot leak -- the
+        # `dropped` counter stays exact past it
         self.dropped_records: List = []
+        self.dropped_records_cap = 1 << 16
         self.on_drop = on_drop or (lambda n: None)
         self.key_counters: Dict[Any, int] = {}
 
@@ -137,7 +139,8 @@ class KSlackLogic(NodeLogic):
             ts = rec.get_control_fields()[2]
             if ts < self.last_timestamp:
                 self.dropped += 1
-                self.dropped_records.append(rec.get_control_fields())
+                if len(self.dropped_records) < self.dropped_records_cap:
+                    self.dropped_records.append(rec.get_control_fields())
                 self.on_drop(1)
                 continue
             self.last_timestamp = ts
