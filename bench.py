@@ -342,11 +342,13 @@ def main():
     for name, c in configs.items():
         print(f"[bench] {name}: {c['rate']:,.0f} tuples/s "
               f"({c['windows']} windows)", file=sys.stderr)
+    base_s = f"{base_rate:,.0f}" if base_rate else "n/a"
+    fused_s = f"{fused_rate:,.0f}" if fused_rate else "n/a"
     print(f"[bench] {backend}: headline {rate2:,.0f} tuples/s "
           f"({windows2} windows in {dt2:.2f}s, p99 batch latency "
           f"{p99:.1f} ms, rtt floor {rtt_ms:.1f} ms); reference-arch C++ "
-          f"baseline: {base_rate:,.0f} tuples/s; fused host path: "
-          f"{fused_rate:,.0f} tuples/s", file=sys.stderr)
+          f"baseline: {base_s} tuples/s; fused host path: "
+          f"{fused_s} tuples/s", file=sys.stderr)
     out = {
         "metric": "keyed sliding-window aggregate throughput",
         "value": round(rate2, 1),

@@ -9,6 +9,7 @@ global-aggregate determinism oracle.
 """
 import random
 import threading
+import zlib
 
 import pytest
 
@@ -78,15 +79,44 @@ def build_window_op(kind, win_type, par, rnd):
             else wf.PaneFarmBuilder(sum_win, sum_win).with_parallelism(2, 1) \
             .with_cb_windows(WIN, SLIDE).build()
         return wf.KeyFarmBuilder(inner).with_parallelism(par).build()
-    elif kind == "wf+wmr":
-        inner = wf.WinMapReduceBuilder(sum_win, sum_win) \
-            .with_parallelism(2, 1).with_tb_windows(WIN, SLIDE).build()
+    elif kind == "wf+pf":
+        inner = _with_wins(wf.PaneFarmBuilder(sum_win, sum_win)
+                           .with_parallelism(2, 1), win_type).build()
         return wf.WinFarmBuilder(inner).with_parallelism(par).build()
+    elif kind == "wf+wmr":
+        inner = _with_wins(wf.WinMapReduceBuilder(sum_win, sum_win)
+                           .with_parallelism(2, 1), win_type).build()
+        return wf.WinFarmBuilder(inner).with_parallelism(par).build()
+    elif kind == "kf+wmr":
+        inner = _with_wins(wf.WinMapReduceBuilder(sum_win, sum_win)
+                           .with_parallelism(2, 1), win_type).build()
+        return wf.KeyFarmBuilder(inner).with_parallelism(par).build()
+    # device-side complex nesting (win_farm_gpu.hpp:73-76,
+    # key_farm_gpu.hpp:254): the inner device stage runs builtin 'sum'
+    elif kind == "wf+pf_tpu":
+        inner = _with_wins(wf.PaneFarmTPUBuilder("sum", sum_win)
+                           .with_parallelism(2, 1), win_type).build()
+        return wf.WinFarmTPUBuilder(inner).with_parallelism(par).build()
+    elif kind == "kf+pf_tpu":
+        inner = _with_wins(wf.PaneFarmTPUBuilder("sum", sum_win)
+                           .with_parallelism(2, 1), win_type).build()
+        return wf.KeyFarmTPUBuilder(inner).with_parallelism(par).build()
+    elif kind == "wf+wmr_tpu":
+        inner = _with_wins(wf.WinMapReduceTPUBuilder("sum", sum_win)
+                           .with_parallelism(2, 1), win_type).build()
+        return wf.WinFarmTPUBuilder(inner).with_parallelism(par).build()
+    elif kind == "kf+wmr_tpu":
+        inner = _with_wins(wf.WinMapReduceTPUBuilder("sum", sum_win)
+                           .with_parallelism(2, 1), win_type).build()
+        return wf.KeyFarmTPUBuilder(inner).with_parallelism(par).build()
     else:
         raise ValueError(kind)
-    b = (b.with_cb_windows(WIN, SLIDE) if win_type == WinType.CB
-         else b.with_tb_windows(WIN, SLIDE))
-    return b.build()
+    return _with_wins(b, win_type).build()
+
+
+def _with_wins(builder, win_type):
+    return (builder.with_tb_windows(WIN, SLIDE) if win_type == WinType.TB
+            else builder.with_cb_windows(WIN, SLIDE))
 
 
 def expected_total(per_key, n_keys, win, slide):
@@ -101,25 +131,38 @@ def expected_total(per_key, n_keys, win, slide):
 
 
 @pytest.mark.parametrize("kind", ["wf", "kf", "kff", "pf", "wmr",
-                                  "kf+pf", "wf+wmr"])
+                                  "kf+pf", "wf+pf", "wf+wmr", "kf+wmr",
+                                  "wf+pf_tpu", "kf+pf_tpu",
+                                  "wf+wmr_tpu", "kf+wmr_tpu"])
 @pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
 def test_matrix_randomized_parallelism(kind, win_type):
-    """The core oracle: run twice with different random parallelisms,
-    totals must match each other and the sequential expectation."""
+    """The core oracle: R randomized repetitions with different random
+    parallelisms (mp_tests style, test_mp_gpu_kff_cb.cpp:81-95), totals
+    must match each other and the sequential expectation.  Streams run
+    long enough (48 windows/key) to cross archive-purge and renumber
+    boundaries at every parallelism."""
     # the parallel prefix destroys per-key order, so the matrix runs in
     # DETERMINISTIC mode (ordering collectors); the DEFAULT-mode
     # renumbering path has its own dedicated test below with tumbling
     # windows, whose totals are arrival-order invariant.
     mode = Mode.DETERMINISTIC
+    per_key = 240
     totals = []
-    for trial in range(2):
-        rnd = random.Random(100 * trial + hash(kind) % 50)
+    for trial in range(3):
+        # crc32, not hash(): PYTHONHASHSEED randomizes hash() per run,
+        # which once let a routing bug hide behind a lucky
+        # parallelism=1 draw
+        rnd = random.Random(100 * trial + zlib.crc32(kind.encode()) % 50)
         sink = SumSink()
         g = wf.PipeGraph("mp", mode)
         fil, fm, mp_ = prefix_ops(rnd)
-        op = build_window_op(kind, win_type, rnd.randint(1, 4), rnd)
+        # trial 0 always runs the outer farm at parallelism >= 2 so
+        # nesting arithmetic is exercised every run
+        op = build_window_op(kind, win_type,
+                             rnd.randint(2, 4) if trial == 0
+                             else rnd.randint(1, 4), rnd)
         pipe = g.add_source(wf.SourceBuilder(
-            ordered_keyed_stream(N_KEYS, PER_KEY)).build())
+            ordered_keyed_stream(N_KEYS, per_key)).build())
         if mode == Mode.DEFAULT:
             pipe.chain(fil).chain(fm).chain(mp_)
         else:
@@ -127,8 +170,8 @@ def test_matrix_randomized_parallelism(kind, win_type):
         pipe.add(op).add_sink(wf.SinkBuilder(sink).build())
         g.run()
         totals.append(sink.total)
-    assert totals[0] == totals[1] == expected_total(PER_KEY, N_KEYS, WIN,
-                                                    SLIDE)
+    assert totals[0] == totals[1] == totals[2] == \
+        expected_total(per_key, N_KEYS, WIN, SLIDE)
 
 
 @pytest.mark.parametrize("kind", ["kf", "kff"])

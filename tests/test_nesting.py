@@ -143,3 +143,33 @@ def test_inner_reuse_rejected():
     wf.WinFarmBuilder(pf).with_parallelism(2).build()
     with pytest.raises(RuntimeError, match="nested"):
         wf.WinFarmBuilder(pf).with_parallelism(2).build()
+
+
+def test_tpu_nesting_builds_device_replicas():
+    """WF_TPU(PF_TPU) / KF_TPU(WMR_TPU) builder dispatch produces the
+    nested structure with DEVICE engine replicas (win_farm_gpu.hpp:
+    73-76, key_farm_gpu.hpp:254) -- not a silent CPU fallback."""
+    from windflow_tpu.operators.nesting import NestedKeyFarm, NestedWinFarm
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPULogic
+
+    def host(gwid, it, res):
+        res.value = sum(t.value for t in it)
+
+    pf = wf.PaneFarmTPUBuilder("sum", host).with_parallelism(2, 1) \
+        .with_tb_windows(WIN, SLIDE).build()
+    op = wf.WinFarmTPUBuilder(pf).with_parallelism(2).build()
+    assert isinstance(op, NestedWinFarm)
+    stages = op.stages()
+    # stage 0 = PLQ of both copies: 2 copies x plq_par 2 device logics
+    assert len(stages[0].replicas) == 4
+    assert all(isinstance(r, WinSeqTPULogic) for r in stages[0].replicas)
+    # copies are group-wired so copy i's WLQ consumes only copy i's PLQ
+    assert stages[0].groups == [0, 0, 1, 1]
+
+    wmr = wf.WinMapReduceTPUBuilder("sum", host).with_parallelism(2, 1) \
+        .with_tb_windows(WIN, SLIDE).build()
+    op2 = wf.KeyFarmTPUBuilder(wmr).with_parallelism(3).build()
+    assert isinstance(op2, NestedKeyFarm)
+    stages2 = op2.stages()
+    assert len(stages2[0].replicas) == 6  # 3 copies x map_par 2
+    assert all(isinstance(r, WinSeqTPULogic) for r in stages2[0].replicas)

@@ -93,7 +93,13 @@ class WinFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
         self.ordered = ordered
         return self
 
-    def build(self) -> WinFarmTPU:
+    def build(self):
+        from ..operators.nesting import NestedWinFarm
+        if isinstance(self.fn, (PaneFarmTPU, WinMapReduceTPU)):
+            # device nesting ctor (win_farm_gpu.hpp:73-76): replicate
+            # the inner device operator; windowing comes from the inner
+            return NestedWinFarm(self.fn, self.parallelism, self.name,
+                                 self.ordered, self.opt_level)
         self._check_windows()
         return WinFarmTPU(self.fn, self.win_len, self.slide_len,
                           self.win_type, self.parallelism, self.batch_len,
@@ -115,7 +121,12 @@ class KeyFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
         self.device_index = 0
         self.emit_batches = False
 
-    def build(self) -> KeyFarmTPU:
+    def build(self):
+        from ..operators.nesting import NestedKeyFarm
+        if isinstance(self.fn, (PaneFarmTPU, WinMapReduceTPU)):
+            # device nesting ctor (key_farm_gpu.hpp:254-...)
+            return NestedKeyFarm(self.fn, self.parallelism, self.name,
+                                 self.opt_level)
         self._check_windows()
         return KeyFarmTPU(self.fn, self.win_len, self.slide_len,
                           self.win_type, self.parallelism, self.batch_len,

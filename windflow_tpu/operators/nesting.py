@@ -1,9 +1,13 @@
 """Complex nesting: Win_Farm / Key_Farm replicating Pane_Farm or
-Win_MapReduce instances.
+Win_MapReduce instances -- host or device variants.
 
 Re-design of the reference's nesting constructors (win_farm.hpp:259-378
-for WF(PF), :379-... for WF(WMR); key_farm.hpp:254-... for KF(PF/WMR))
-and MultiPipe's complex-nesting dispatch (multipipe.hpp:1014-1099).
+for WF(PF), :379-... for WF(WMR); key_farm.hpp:254-... for KF(PF/WMR);
+device nesting win_farm_gpu.hpp:73-76,:111-117 and key_farm_gpu.hpp:254
+for WF_GPU(PF_GPU)/KF_GPU(WMR_GPU)) and MultiPipe's complex-nesting
+dispatch (multipipe.hpp:1014-1099).  The same grouped-stage wiring
+serves both planes: a device inner just contributes WinSeqTPULogic
+replicas instead of WinSeqLogic ones.
 
 Construction follows the reference exactly:
 * WF(inner): R copies of the inner operator, copy i configured with
@@ -30,14 +34,17 @@ from ..runtime.win_routing import KFEmitter, WFEmitter, WidOrderCollector
 from .base import Operator, StageSpec
 from .pane_farm import PaneFarm
 from .win_mapreduce import WinMapReduce
+from .tpu.farms_tpu import PaneFarmTPU, WinMapReduceTPU
 
-InnerOp = Union[PaneFarm, WinMapReduce]
+InnerOp = Union[PaneFarm, WinMapReduce, PaneFarmTPU, WinMapReduceTPU]
 
 
 def _clone_inner(inner: InnerOp, idx: int, n_replicas: int,
                  outer_slide: int, private_slide: int) -> InnerOp:
     """Build copy ``idx`` of the inner operator with the nested config
-    (the panewrap_farm_t construction, win_farm.hpp:324-374)."""
+    (the panewrap_farm_t construction, win_farm.hpp:324-374; the device
+    twins follow win_farm_gpu.hpp:73-76 -- same arithmetic, device
+    engine replicas)."""
     cfg = WinOperatorConfig(0, 1, outer_slide, idx, n_replicas, outer_slide)
     if isinstance(inner, PaneFarm):
         return PaneFarm(
@@ -55,6 +62,24 @@ def _clone_inner(inner: InnerOp, idx: int, n_replicas: int,
             inner.reduce_incremental, f"{inner.name}_{idx}",
             inner.result_factory, inner.closing_func, ordered=False,
             opt_level=inner.opt_level, config=cfg)
+    if isinstance(inner, PaneFarmTPU):
+        return PaneFarmTPU(
+            inner.plq, inner.wlq, inner.win_len, private_slide,
+            inner.win_type, inner.plq_par, inner.wlq_par,
+            plq_on_tpu=inner.plq_on_tpu, wlq_on_tpu=not inner.plq_on_tpu,
+            batch_len=inner.batch_len,
+            triggering_delay=inner.triggering_delay,
+            name=f"{inner.name}_{idx}", result_factory=inner.result_factory,
+            value_of=inner.value_of, ordered=False,
+            opt_level=inner.opt_level, config=cfg)
+    if isinstance(inner, WinMapReduceTPU):
+        return WinMapReduceTPU(
+            inner.map_stage, inner.reduce_stage, inner.win_len,
+            private_slide, inner.win_type, inner.map_par, inner.reduce_par,
+            map_on_tpu=inner.map_on_tpu, batch_len=inner.batch_len,
+            triggering_delay=inner.triggering_delay,
+            name=f"{inner.name}_{idx}", result_factory=inner.result_factory,
+            value_of=inner.value_of, ordered=False, config=cfg)
     raise TypeError(f"cannot nest {type(inner).__name__}")
 
 

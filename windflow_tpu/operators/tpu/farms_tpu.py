@@ -160,7 +160,8 @@ class PaneFarmTPU(_TPUWinOp):
                  wlq_on_tpu=False, batch_len=DEFAULT_BATCH_LEN,
                  triggering_delay=0, name="pane_farm_tpu",
                  result_factory=BasicRecord, value_of=None, ordered=True,
-                 opt_level=OptLevel.LEVEL0):
+                 opt_level=OptLevel.LEVEL0,
+                 config: WinOperatorConfig = None):
         super().__init__(name, plq_parallelism + wlq_parallelism,
                          RoutingMode.COMPLEX, Pattern.PANE_FARM_TPU,
                          win_type)
@@ -182,7 +183,10 @@ class PaneFarmTPU(_TPUWinOp):
         self.ordered = ordered
         self.opt_level = opt_level
         self.pane_len = pane_length(win_len, slide_len)
-        self.config = WinOperatorConfig(0, 1, slide_len, 0, 1, slide_len)
+        # enclosing config: identity standalone, nested arithmetic when
+        # replicated inside a Win_Farm/Key_Farm (win_farm_gpu.hpp:73-76)
+        self.config = config or WinOperatorConfig(0, 1, slide_len,
+                                                  0, 1, slide_len)
 
     def stages(self):
         cfg = self.config
@@ -197,8 +201,14 @@ class PaneFarmTPU(_TPUWinOp):
                 result_factory=self.result_factory, value_of=self.value_of,
                 enclosing=cfg, role=Role.PLQ,
                 farm_kind="wf" if self.plq_par > 1 else "seq")
+            # the enclosing offsets shift pane membership when this
+            # operator is a nested copy (the configSeq construction,
+            # win_farm.hpp:175; emitter without them routes panes
+            # relative to 0 and starves the copy's workers)
             emitter = (WFEmitter(pane, pane, self.plq_par, self.win_type,
-                                 Role.PLQ)
+                                 Role.PLQ, id_outer=cfg.id_inner,
+                                 n_outer=cfg.n_inner,
+                                 slide_outer=cfg.slide_inner)
                        if self.plq_par > 1 else StandardEmitter())
             stages.append(StageSpec(
                 f"{self.name}_plq", reps, emitter, RoutingMode.COMPLEX,
@@ -223,7 +233,9 @@ class PaneFarmTPU(_TPUWinOp):
                 enclosing=cfg, role=Role.WLQ,
                 farm_kind="wf" if self.wlq_par > 1 else "seq")
             emitter = (WFEmitter(wlq_win, wlq_slide, self.wlq_par,
-                                 WinType.CB, Role.WLQ)
+                                 WinType.CB, Role.WLQ,
+                                 id_outer=cfg.id_inner, n_outer=cfg.n_inner,
+                                 slide_outer=cfg.slide_inner)
                        if self.wlq_par > 1
                        else StandardEmitter(keyed=True))
             stages.append(StageSpec(
@@ -264,7 +276,8 @@ class WinMapReduceTPU(_TPUWinOp):
                  win_type, map_parallelism=2, reduce_parallelism=1,
                  map_on_tpu=True, batch_len=DEFAULT_BATCH_LEN,
                  triggering_delay=0, name="win_mr_tpu",
-                 result_factory=BasicRecord, value_of=None, ordered=True):
+                 result_factory=BasicRecord, value_of=None, ordered=True,
+                 config: WinOperatorConfig = None):
         super().__init__(name, map_parallelism + reduce_parallelism,
                          RoutingMode.COMPLEX, Pattern.WIN_MAPREDUCE_TPU,
                          win_type)
@@ -280,7 +293,8 @@ class WinMapReduceTPU(_TPUWinOp):
         self.result_factory = result_factory
         self.value_of = value_of
         self.ordered = ordered
-        self.config = WinOperatorConfig(0, 1, slide_len, 0, 1, slide_len)
+        self.config = config or WinOperatorConfig(0, 1, slide_len,
+                                                  0, 1, slide_len)
 
     def stages(self):
         cfg = self.config

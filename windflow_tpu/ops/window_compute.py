@@ -46,6 +46,17 @@ PAIR_KINDS = ("mean_panes",)
 _GLOBAL_DISPATCH_LOCK = threading.Lock()
 
 
+def _transfer_guard():
+    """Serialization context for device transfers: the global lock when
+    the escape hatch is on (D2H in block() must serialize against every
+    engine's H2D, not just its own), else a no-op."""
+    import contextlib
+    import os
+    if os.environ.get("WINDFLOW_GLOBAL_DISPATCH_LOCK") == "1":
+        return _GLOBAL_DISPATCH_LOCK
+    return contextlib.nullcontext()
+
+
 def next_pow2(n: int) -> int:
     p = 1
     while p < max(1, n):
@@ -308,7 +319,8 @@ class DeviceBatchHandle:
             return False
 
     def block(self) -> np.ndarray:
-        return np.asarray(self._dev)[: self._n]
+        with _transfer_guard():
+            return np.asarray(self._dev)[: self._n]
 
 
 class WindowComputeEngine:
