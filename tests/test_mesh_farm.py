@@ -209,3 +209,142 @@ def test_pane_farm_mesh_large_first_timestamp_anchors(win, slide, OFFSET):
         while w * slide + win <= OFFSET + per_key:
             assert (k, w) in got, (k, w)
             w += 1
+
+
+@pytest.mark.parametrize("kind", ["count", "mean", "max", "min", "ffat"])
+def test_mesh_farm_kinds_match_oracle(mesh, kind):
+    """KeyFarmMesh beyond sum: builtin count/mean/max/min via the
+    sharded programs, and FFAT lift+combine via the per-shard device
+    FlatFAT (key_farm_gpu.hpp arbitrary functors at mesh scale)."""
+    import jax.numpy as jnp
+
+    win, slide = 12, 4
+    n_keys, per_key = 8, 40
+    rngs = {k: np.random.default_rng(k).normal(size=per_key)
+            for k in range(n_keys)}
+    state = {"sent": 0}
+
+    def source(ctx):
+        i = state["sent"]
+        total = n_keys * per_key
+        if i >= total:
+            return None
+        n = min(256, total - i)
+        idx = i + np.arange(n)
+        keys, ids = idx % n_keys, idx // n_keys
+        vals = np.empty(n)
+        for k in range(n_keys):
+            m = keys == k
+            vals[m] = rngs[k][ids[m]]
+        state["sent"] = i + n
+        return TupleBatch({"key": keys, "id": ids, "ts": ids,
+                           "value": vals})
+
+    spec = (("ffat", lambda v: np.abs(v), jnp.maximum, float("-inf"))
+            if kind == "ffat" else kind)
+
+    got = {}
+    lock = threading.Lock()
+
+    def sink(item):
+        if item is None:
+            return
+        with lock:
+            for j in range(len(item)):
+                got.setdefault(int(item.key[j]), {})[
+                    int(item.id[j])] = float(item["value"][j])
+
+    g = wf.PipeGraph("mesh-kinds", Mode.DEFAULT)
+    op = KeyFarmMesh(mesh, win, slide, WinType.TB, batch_windows=16,
+                     kind=spec)
+    g.add_source(BatchSource(source)).add(op).add_sink(Sink(sink))
+    g.run()
+
+    def expect(seg):
+        if kind == "count":
+            return float(len(seg))
+        if kind == "mean":
+            return float(seg.mean())
+        if kind == "max":
+            return float(seg.max())
+        if kind == "min":
+            return float(seg.min())
+        return float(np.abs(seg).max())  # ffat: max of |lifted|
+
+    assert set(got) == set(range(n_keys))
+    for k in range(n_keys):
+        g_ = 0
+        while g_ * slide < per_key:
+            seg = rngs[k][g_ * slide: g_ * slide + win]
+            assert abs(got[k][g_] - expect(seg)) < 1e-5 * max(
+                1, abs(expect(seg))), (kind, k, g_)
+            g_ += 1
+
+
+@pytest.mark.parametrize("kind", ["max", "ffat"])
+def test_pane_farm_mesh_kinds(kind):
+    """PaneFarmMesh beyond sum: pane partials and the ring window fold
+    both run the selected combine."""
+    import jax.numpy as jnp
+    from windflow_tpu.operators.tpu.pane_mesh import PaneFarmMesh
+
+    mesh2 = make_mesh(8, win_axis=2)
+    win, slide, per_key, n_keys = 32, 8, 600, 4
+    rngs = {k: np.random.default_rng(100 + k).normal(size=per_key)
+            for k in range(n_keys)}
+    state = {"sent": 0}
+
+    def source(ctx):
+        i = state["sent"]
+        total = n_keys * per_key
+        if i >= total:
+            return None
+        n = min(512, total - i)
+        idx = i + np.arange(n)
+        keys, ids = idx % n_keys, idx // n_keys
+        vals = np.empty(n)
+        for k in range(n_keys):
+            m = keys == k
+            vals[m] = rngs[k][ids[m]]
+        state["sent"] = i + n
+        return TupleBatch({"key": keys, "id": ids, "ts": ids,
+                           "value": vals})
+
+    spec = (("ffat", None, jnp.minimum, float("inf"))
+            if kind == "ffat" else kind)
+
+    got = {}
+    lock = threading.Lock()
+
+    def sink(item):
+        if item is None:
+            return
+        with lock:
+            for j in range(len(item)):
+                got[(int(item.key[j]), int(item.id[j]))] = \
+                    float(item["value"][j])
+
+    g = wf.PipeGraph("pmesh-kinds", Mode.DEFAULT)
+    op = PaneFarmMesh(mesh2, win, slide, WinType.TB, panes_per_epoch=16,
+                      kind=spec)
+    g.add_source(BatchSource(source)).add(op).add_sink(Sink(sink))
+    g.run()
+    assert got
+    bad = 0
+    for k in range(n_keys):
+        w = 0
+        while w * slide < per_key:
+            seg = rngs[k][w * slide: w * slide + win]
+            want = float(seg.max() if kind == "max" else seg.min())
+            gv = got.get((k, w))
+            if gv is None or abs(gv - want) > 1e-5 * max(1, abs(want)):
+                bad += 1
+            w += 1
+    assert bad == 0, (bad, len(got))
+
+
+def test_mesh_mean_rejected_on_pane_farm():
+    from windflow_tpu.operators.tpu.pane_mesh import PaneFarmMesh
+    mesh2 = make_mesh(8, win_axis=2)
+    with pytest.raises(ValueError, match="mean"):
+        PaneFarmMesh(mesh2, 8, 4, WinType.TB, kind="mean")

@@ -65,6 +65,8 @@ class KeyFarmMeshLogic(NodeLogic):
         ids, vals = ids[keep], vals[keep]
         if len(ids) == 0:
             return
+        if self.engine.lift is not None:  # FFAT lift, columnar
+            vals = np.asarray(self.engine.lift(vals))
         st.ids.append(ids)
         st.vals.append(vals)
         st.max_id = max(st.max_id, int(ids.max()))
@@ -137,7 +139,9 @@ class KeyFarmMeshLogic(NodeLogic):
         B_pad = 1
         while B_pad < B:
             B_pad <<= 1
-        values = np.zeros((S, T_pad), np.float32)
+        # pad with the combine's neutral: extents never read padding,
+        # but max/min/ffat tree builds must not poison internal nodes
+        values = np.full((S, T_pad), self.engine.neutral, np.float32)
         for sh in range(S):
             if shard_vals[sh]:
                 flat = np.concatenate(shard_vals[sh])
@@ -192,14 +196,21 @@ class KeyFarmMeshLogic(NodeLogic):
 
 
 class KeyFarmMesh(Operator):
+    """``kind`` is a builtin combine name ('sum'/'count'/'mean'/'max'/
+    'min') or an FFAT spec ('ffat', lift, combine, neutral) -- lift is
+    applied columnar on the host at ingest, combine runs in the
+    per-shard device FlatFAT (key_farm_gpu.hpp / key_ffat_gpu.hpp at
+    mesh scale)."""
+
     def __init__(self, mesh, win_len: int, slide_len: int,
                  win_type: WinType, batch_windows: int = 1024,
-                 name: str = "key_farm_mesh", emit_batches: bool = True):
+                 name: str = "key_farm_mesh", emit_batches: bool = True,
+                 kind="sum"):
         super().__init__(name, 1, RoutingMode.FORWARD,
                          Pattern.KEY_FARM_TPU)
         from ...parallel.sharded import ShardedWindowEngine
         self.win_type = win_type
-        self.engine = ShardedWindowEngine(mesh, win_len, slide_len)
+        self.engine = ShardedWindowEngine(mesh, win_len, slide_len, kind)
         self.args = (win_len, slide_len, win_type, batch_windows,
                      emit_batches)
 

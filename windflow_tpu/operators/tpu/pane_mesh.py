@@ -35,11 +35,11 @@ from ..base import Operator, StageSpec
 class _PaneKeyState:
     __slots__ = ("panes", "pane_base", "max_id", "partial", "partial_pane")
 
-    def __init__(self):
+    def __init__(self, neutral: float = 0.0):
         self.panes: List[float] = []  # complete pane partials
         self.pane_base = 0            # global pane index of panes[0]
         self.max_id = -1
-        self.partial = 0.0            # open (incomplete) pane accumulator
+        self.partial = neutral        # open (incomplete) pane accumulator
         self.partial_pane = 0         # its global pane index
 
 
@@ -48,6 +48,10 @@ class PaneFarmMeshLogic(NodeLogic):
                  win_type: WinType, panes_per_epoch: int = 64,
                  emit_batches: bool = True):
         self.engine = engine
+        self.kind = engine.kind
+        self.combine = engine.combine
+        self.neutral = engine.neutral
+        self.lift = engine.lift
         self.win_len = win_len
         self.slide_len = slide_len
         self.win_type = win_type
@@ -74,11 +78,36 @@ class PaneFarmMeshLogic(NodeLogic):
     # timestamps with a mis-sized pane) and filling would OOM
     MAX_GAP_PANES = 1 << 20
 
+    def _fold_chunk(self, partial: float, vals) -> float:
+        """Fold one chunk of a pane's values into its open accumulator
+        (the host PLQ, generalized over the combine kind)."""
+        k = self.kind
+        if k == "sum":
+            return partial + float(vals.sum())
+        if k == "count":
+            return partial + float(len(vals))
+        if k == "max":
+            return max(partial, float(vals.max()))
+        if k == "min":
+            return min(partial, float(vals.min()))
+        # ffat: host-side lift + pairwise combine tree (the __host__
+        # half of the reference's combine contract,
+        # flatfat_gpu.hpp:68-82) -- log2(n) array-level combine calls
+        # per chunk, not one scalar dispatch per tuple
+        seq = np.asarray(self.lift(vals) if self.lift is not None
+                         else vals, np.float64)
+        while len(seq) > 1:
+            if len(seq) % 2:
+                seq = np.append(seq, self.neutral)
+            seq = np.asarray(self.combine(seq[0::2], seq[1::2]))
+        return float(self.combine(partial, seq[0])) if len(seq) \
+            else partial
+
     # -- host PLQ: pane pre-reduction ---------------------------------
     def _ingest_key(self, key, ids, vals) -> None:
         st = self.keys.get(key)
         if st is None:
-            st = self.keys[key] = _PaneKeyState()
+            st = self.keys[key] = _PaneKeyState(self.neutral)
             # anchor the pane timeline at the first window containing
             # the first tuple (not pane 0): a large first id/ts (e.g.
             # epoch-millis TB streams) must not materialize ~1e9 empty
@@ -108,10 +137,10 @@ class PaneFarmMeshLogic(NodeLogic):
                         "dense-id scope (check pane/window sizing)")
                 # panes up to cur-1 are complete
                 st.panes.append(st.partial)
-                st.panes.extend([0.0] * gap)  # empty panes
-                st.partial = 0.0
+                st.panes.extend([self.neutral] * gap)  # empty panes
+                st.partial = self.neutral
                 st.partial_pane = cur
-            st.partial += float(vals[lo:hi].sum())
+            st.partial = self._fold_chunk(st.partial, vals[lo:hi])
             lo = hi
 
     def svc(self, item, channel_id, emit):
@@ -159,11 +188,13 @@ class PaneFarmMeshLogic(NodeLogic):
         drop the key's panes entirely."""
         S = self.engine.n_key_shards
         K = ((len(ready) + S - 1) // S) * S  # pad rows to the key axis
-        pane_vals = np.zeros((K, self.p_total, 1), np.float32)
+        # neutral-padded staging: clipped EOS tail windows then combine
+        # only the real panes
+        pane_vals = np.full((K, self.p_total, 1), self.neutral, np.float32)
         for r, key in enumerate(ready):
             panes = self.keys[key].panes
             take = min(self.p_total, len(panes))
-            pane_vals[r, :take, 0] = panes[:take]  # zeros pad the tail
+            pane_vals[r, :take, 0] = panes[:take]
         out = np.asarray(self.engine.compute_pf_ring(pane_vals, 1))
         self.launched_batches += 1
         rec_keys: List = []
@@ -206,12 +237,12 @@ class PaneFarmMeshLogic(NodeLogic):
 
     def eos_flush(self, emit):
         # close each key's open pane, then drain EOS epochs: the staging
-        # array zero-pads short timelines (the sum identity), so clipped
-        # tail windows come out as partial sums
+        # array pads short timelines with the combine's neutral, so
+        # clipped tail windows come out as partial combines
         for st in self.keys.values():
             if st.max_id >= 0:
                 st.panes.append(st.partial)
-                st.partial = 0.0
+                st.partial = self.neutral
                 st.partial_pane += 1
         while True:
             remaining = [k for k, st in self.keys.items() if st.panes]
@@ -226,16 +257,25 @@ class PaneFarmMesh(Operator):
 
     def __init__(self, mesh, win_len: int, slide_len: int,
                  win_type: WinType, panes_per_epoch: int = 64,
-                 name: str = "pane_farm_mesh", emit_batches: bool = True):
+                 name: str = "pane_farm_mesh", emit_batches: bool = True,
+                 kind="sum"):
         super().__init__(name, 1, RoutingMode.FORWARD,
                          Pattern.PANE_FARM_TPU)
         from ...parallel.sharded import ShardedWindowEngine
         self.win_type = win_type
         # the host pre-reduces panes, so the ring engine works in PANE
-        # units: its window = wpp panes of width 1, slide = spp panes
+        # units: its window = wpp panes of width 1, slide = spp panes.
+        # ``kind``: builtin combine or ('ffat', lift, combine, neutral);
+        # 'mean' is rejected (panes carry no count channel).  A window
+        # whose extent holds only empty panes combines to the kind's
+        # neutral, not the single-chip engines' masked 0.
+        if kind == "mean":
+            raise ValueError(
+                "PaneFarmMesh does not support 'mean': pane partials "
+                "carry no count channel (use KeyFarmMesh)")
         pane = int(np.gcd(win_len, slide_len))
         self.engine = ShardedWindowEngine(mesh, win_len // pane,
-                                          slide_len // pane)
+                                          slide_len // pane, kind)
         self.args = (win_len, slide_len, win_type, panes_per_epoch,
                      emit_batches)
 

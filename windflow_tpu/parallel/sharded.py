@@ -103,16 +103,55 @@ def _sharded_programs(mesh_id: int, win_len: int, slide_len: int):
 _MESHES: Dict[int, Any] = {}
 
 
+def _resolve_kind(kind):
+    """Normalize a mesh combine spec to (name, combine, neutral, lift).
+
+    ``kind`` is a builtin name ('sum'/'count'/'mean'/'max'/'min') or an
+    FFAT spec -- either the single-chip 3-tuple ('ffat', combine,
+    neutral) that farms_tpu._ffat_kind produces (lift rides separately
+    there) or the mesh 4-tuple ('ffat', lift, combine, neutral) with a
+    columnar lift.  The combine must work on numpy scalars AND jnp
+    arrays -- the mesh twin of the reference's __host__ __device__
+    combine contract (flatfat_gpu.hpp:68-82)."""
+    if isinstance(kind, tuple) and kind and kind[0] == "ffat":
+        if len(kind) == 4:
+            _, lift, combine, neutral = kind
+        elif len(kind) == 3:
+            lift, (_, combine, neutral) = None, kind
+        else:
+            raise ValueError(
+                "FFAT mesh kind must be ('ffat', combine, neutral) or "
+                "('ffat', lift, combine, neutral)")
+        return "ffat", combine, float(neutral), lift
+    if kind == "max":
+        import jax.numpy as jnp
+        return "max", jnp.maximum, float("-inf"), None
+    if kind == "min":
+        import jax.numpy as jnp
+        return "min", jnp.minimum, float("inf"), None
+    if kind in ("sum", "count", "mean"):
+        return kind, None, 0.0, None
+    raise ValueError(f"unknown mesh window kind: {kind!r}")
+
+
 class ShardedWindowEngine:
     """Key-sharded multi-chip window engine (the distributed twin of
     WindowComputeEngine).  Holds the mesh; each call runs the full
     sharded step (KF + WMR + PF paths) as one XLA program with
-    collectives over ICI."""
+    collectives over ICI.
 
-    def __init__(self, mesh, win_len: int, slide_len: int):
+    ``kind`` selects the combine (see _resolve_kind): invertible kinds
+    run prefix-scan differencing per shard; max/min and FFAT
+    lift+combine build a per-shard device FlatFAT and answer every
+    extent with a range query (the key_farm_gpu.hpp arbitrary-functor
+    surface at mesh scale)."""
+
+    def __init__(self, mesh, win_len: int, slide_len: int, kind="sum"):
         self.mesh = mesh
         self.win_len = win_len
         self.slide_len = slide_len
+        self.kind, self.combine, self.neutral, self.lift = \
+            _resolve_kind(kind)
         mesh_id = id(mesh)
         _MESHES[mesh_id] = mesh
         self._step = _sharded_programs(mesh_id, win_len, slide_len)
@@ -162,13 +201,41 @@ class ShardedWindowEngine:
         hops = min(W - 1, -(-(wpp - 1) // p_loc))  # ceil, capped at ring
         n_loc_wins = p_loc // spp
 
-        key = (id(self.mesh), wpp, spp, W, p_loc, pane_len)
+        if self.kind == "mean":
+            raise ValueError("PaneFarmMesh does not support 'mean' "
+                             "(pane partials are not mean-decomposable "
+                             "without a count channel)")
+        key = (id(self.mesh), wpp, spp, W, p_loc, pane_len, self.kind)
         if getattr(self, "_ring_key", None) != key:
             perm = [(i, (i - 1) % W) for i in range(W)]
+            kind, comb = self.kind, self.combine
+
+            neutral = self.neutral
+
+            def fold(x, axis):
+                # combine-fold along one axis: one-op reductions for the
+                # builtins; a log-depth pairwise tree for a custom FFAT
+                # combine (associative by contract) so a wide window
+                # extent costs O(log w) HLO ops, not a serial chain
+                if kind in ("sum", "count"):
+                    return jnp.sum(x, axis=axis)
+                if kind == "max":
+                    return jnp.max(x, axis=axis)
+                if kind == "min":
+                    return jnp.min(x, axis=axis)
+                x = jnp.moveaxis(x, axis, -1)
+                while x.shape[-1] > 1:
+                    n = x.shape[-1]
+                    if n % 2:
+                        pad = jnp.full(x.shape[:-1] + (1,), neutral,
+                                       x.dtype)
+                        x = jnp.concatenate([x, pad], axis=-1)
+                    x = comb(x[..., 0::2], x[..., 1::2])
+                return x[..., 0]
 
             def ring_shard(pane_vals):
                 # [K, P_loc, pane_len] per shard
-                partials = jnp.sum(pane_vals, axis=-1)     # [K, P_loc]
+                partials = fold(pane_vals, -1)             # [K, P_loc]
                 blocks = [partials]
                 cur = partials
                 for _ in range(hops):
@@ -182,7 +249,7 @@ class ShardedWindowEngine:
                 # valid window g_start + wpp <= p_total implies the
                 # extent fits inside ext)
                 idx = jnp.minimum(idx, ext.shape[-1] - 1)
-                wins = jnp.sum(ext[:, idx], axis=-1)       # [K, n_loc]
+                wins = fold(ext[:, idx], -1)               # [K, n_loc]
                 # mask windows whose extent passes the global end (their
                 # ring reads wrapped around to chip 0)
                 w_id = jax.lax.axis_index("win")
@@ -226,20 +293,37 @@ class ShardedWindowEngine:
         return out[:, 0, :]
 
     def compute_kf(self, values, starts, ends):
-        """Key-sharded window sums only (the Key_Farm-across-chips path
-        used by operators.tpu.mesh_farm).  ``values`` is [K_shards, T],
-        extents are [K_shards, B]; everything sharded over 'key'."""
+        """Key-sharded window combines (the Key_Farm-across-chips path
+        used by operators.tpu.mesh_farm).  ``values`` is [K_shards, T]
+        (T a power of two), extents are [K_shards, B]; everything
+        sharded over 'key'."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         if not hasattr(self, "_kf_only"):
             import jax.numpy as jnp
+            kind, comb, neutral = self.kind, self.combine, self.neutral
 
             def kf_shard(v, s, e):
-                c = jnp.concatenate([jnp.zeros((1, 1), v.dtype),
-                                     jnp.cumsum(v, axis=1)], axis=1)
-                return jnp.take_along_axis(c, e, axis=1) - \
-                    jnp.take_along_axis(c, s, axis=1)
+                if kind == "count":
+                    return (e - s).astype(v.dtype)
+                if kind in ("sum", "mean"):
+                    c = jnp.concatenate([jnp.zeros((1, 1), v.dtype),
+                                         jnp.cumsum(v, axis=1)], axis=1)
+                    out = jnp.take_along_axis(c, e, axis=1) - \
+                        jnp.take_along_axis(c, s, axis=1)
+                    if kind == "mean":
+                        out = out / jnp.maximum(e - s, 1)
+                    return out
+                # max/min/ffat: per-row device FlatFAT + range queries
+                from ..ops.flatfat_jax import _programs
+                build, _upd, query = _programs(comb, neutral, v.shape[1])
+
+                def one(row, ss, ee):
+                    return query(build(row), ss, ee, ee > ss)
+
+                out = jax.vmap(one)(v, s, e)
+                return jnp.where(e > s, out, 0)
 
             self._kf_only = jax.jit(jax.shard_map(
                 kf_shard, mesh=self.mesh,
