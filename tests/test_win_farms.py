@@ -189,3 +189,44 @@ def test_randomized_parallelism_determinism():
         coll = run_graph(b.build(), n_keys=7, per_key=60)
         totals.add(coll.total())
     assert len(totals) == 1
+
+
+@pytest.mark.parametrize("tpu", [False, True])
+@pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
+def test_pane_farm_level2_fusion(tpu, win_type):
+    """LEVEL2 single/single PLQ+WLQ fuse into one thread (ff_comb of
+    optimize_PaneFarm, pane_farm.hpp:222-250): thread count drops by
+    one and oracle totals are unchanged."""
+    from windflow_tpu.core.basic import OptLevel
+    from windflow_tpu.runtime.node import ChainedLogic
+
+    def comb_win(gwid, iterable, result):
+        result.value = sum(t.value for t in iterable)
+
+    def build(lvl):
+        if tpu:
+            b = wf.PaneFarmTPUBuilder("sum", comb_win).with_parallelism(1, 1)
+        else:
+            b = wf.PaneFarmBuilder(sum_win, comb_win).with_parallelism(1, 1)
+        return (b.with_cb_windows(12, 4) if win_type == WinType.CB
+                else b.with_tb_windows(12, 4)).with_opt_level(lvl).build()
+
+    stages = build(OptLevel.LEVEL2).stages()
+    assert len(stages) == 1
+    assert isinstance(stages[0].replicas[0], ChainedLogic)
+
+    threads = {}
+    colls = {}
+    for lvl in (OptLevel.LEVEL0, OptLevel.LEVEL2):
+        op = build(lvl)
+        coll = Collector()
+        g = wf.PipeGraph("t", Mode.DEFAULT)
+        g.add_source(wf.SourceBuilder(ordered_source(3, 48)).build()) \
+            .add(op).add_sink(wf.SinkBuilder(coll).build())
+        g.run()
+        threads[lvl] = g.thread_count()
+        colls[lvl] = coll.by_key()
+    assert threads[OptLevel.LEVEL2] == threads[OptLevel.LEVEL0] - 1
+    expect = oracle(48, 12, 4)
+    assert colls[OptLevel.LEVEL0] == colls[OptLevel.LEVEL2] \
+        == {k: expect for k in range(3)}

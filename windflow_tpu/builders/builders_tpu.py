@@ -223,8 +223,10 @@ class WinSeqFFATTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
         """rebuild=True (default): the tree is rebuilt from the staged
         flat buffer every device launch.  rebuild=False: the per-key
         forest stays resident in HBM and is incrementally updated (the
-        Win_SeqFFAT_GPU ``rebuild`` flag, win_seqffat_gpu.hpp:150);
-        count-based windows only."""
+        Win_SeqFFAT_GPU ``rebuild`` flag, win_seqffat_gpu.hpp:150).
+        CB windows ride the arrival-order leaf ring; TB windows need
+        per-key in-order timestamps (ring eviction is keyed on the
+        timestamp proof) -- out-of-order TB streams use rebuild=True."""
         self.rebuild = rebuild
         return self
 
@@ -247,14 +249,10 @@ class WinSeqFFATTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
         self._check_windows()
         if not self.rebuild:
             from ..operators.tpu.ffat_resident import WinSeqFFATResident
-            if self.win_type != WinType.CB:
-                raise ValueError("rebuild=False supports count-based "
-                                 "windows only (use the rebuild path "
-                                 "for time-based)")
             fn, neutral = self._resident_combine()
             return WinSeqFFATResident(self.fn, fn, neutral, self.win_len,
-                                      self.slide_len, self.name,
-                                      self.result_factory)
+                                      self.slide_len, self.win_type,
+                                      self.name, self.result_factory)
         return WinSeqFFATTPU(self.fn, self.combine, self.win_len,
                              self.slide_len, self.win_type, self.batch_len,
                              self.triggering_delay, self.name,

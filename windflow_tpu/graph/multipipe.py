@@ -20,29 +20,9 @@ from ..runtime.ordering import KSlackLogic, OrderingLogic
 from ..runtime.queues import Channel, make_channel
 
 
-class ChainedLogic(NodeLogic):
-    """Thread fusion of two logics: b consumes a's emissions inline
-    (the reference's combine_with_laststage, multipipe.hpp:381)."""
-
-    def __init__(self, a: NodeLogic, b: NodeLogic):
-        self.a = a
-        self.b = b
-
-    def svc_init(self):
-        self.a.svc_init()
-        self.b.svc_init()
-
-    def svc(self, item, channel_id, emit):
-        self.a.svc(item, channel_id,
-                   lambda x: self.b.svc(x, 0, emit))
-
-    def eos_flush(self, emit):
-        self.a.eos_flush(lambda x: self.b.svc(x, 0, emit))
-        self.b.eos_flush(emit)
-
-    def svc_end(self):
-        self.a.svc_end()
-        self.b.svc_end()
+# re-export: ChainedLogic moved to runtime.node so operators (PaneFarm
+# LEVEL2 fusion) can use it without importing the graph layer
+from ..runtime.node import ChainedLogic  # noqa: F401
 
 
 class MultiPipe:

@@ -59,7 +59,48 @@ class PaneFarm(Operator):
                                                   0, 1, slide_len)
         self.pane_len = pane_length(win_len, slide_len)
 
+    def _fused_logics(self):
+        """PLQ + WLQ logics for the LEVEL1/2 thread fusion (the ff_comb
+        branch of optimize_PaneFarm, pane_farm.hpp:222-250): both stages
+        run in ONE thread via ChainedLogic.  Only valid when both
+        parallelisms are 1; the farm-farm LEVEL2 merge maps onto this
+        runtime as collector stripping, which the inner WinFarms already
+        do at LEVEL1+."""
+        cfg = self.config
+        pane = self.pane_len
+        plq = WinSeqLogic(
+            self.plq_func, pane, pane, self.win_type,
+            triggering_delay=self.triggering_delay,
+            incremental=self.plq_incremental,
+            result_factory=self.result_factory,
+            closing_func=self.closing_func,
+            config=WinOperatorConfig(cfg.id_inner, cfg.n_inner,
+                                     cfg.slide_inner, 0, 1, pane),
+            role=Role.PLQ)
+        wlq_win = self.win_len // pane
+        wlq_slide = self.slide_len // pane
+        wlq = WinSeqLogic(
+            self.wlq_func, wlq_win, wlq_slide, WinType.CB,
+            incremental=self.wlq_incremental,
+            result_factory=self.result_factory,
+            closing_func=self.closing_func,
+            config=WinOperatorConfig(cfg.id_inner, cfg.n_inner,
+                                     cfg.slide_inner, 0, 1, wlq_slide),
+            role=Role.WLQ)
+        return plq, wlq
+
     def stages(self):
+        if (self.opt_level != OptLevel.LEVEL0
+                and self.plq_parallelism == 1
+                and self.wlq_parallelism == 1):
+            from ..runtime.node import ChainedLogic
+            plq, wlq = self._fused_logics()
+            return [StageSpec(
+                f"{self.name}_fused", [ChainedLogic(plq, wlq)],
+                StandardEmitter(), RoutingMode.FORWARD,
+                ordering_mode=(OrderingMode.ID
+                               if self.win_type == WinType.CB
+                               else OrderingMode.TS))]
         cfg = self.config
         pane = self.pane_len
         stages = []

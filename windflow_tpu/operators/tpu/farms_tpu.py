@@ -188,7 +188,56 @@ class PaneFarmTPU(_TPUWinOp):
         self.config = config or WinOperatorConfig(0, 1, slide_len,
                                                   0, 1, slide_len)
 
+    def _fused_stage(self):
+        """LEVEL1/2 single/single thread fusion (ff_comb of
+        optimize_PaneFarm, pane_farm.hpp:222-250): the device stage and
+        the host stage run chained in one thread.  The device logic's
+        async dispatcher keeps overlapping launches; the chained
+        consumer runs on whichever thread flushes the batch."""
+        from ...runtime.node import ChainedLogic
+        cfg = self.config
+        pane = self.pane_len
+        wlq_win = self.win_len // pane
+        wlq_slide = self.slide_len // pane
+        if self.plq_on_tpu:
+            plq = _tpu_replicas(
+                self.plq, pane, pane, self.win_type, 1,
+                batch_len=self.batch_len,
+                triggering_delay=self.triggering_delay,
+                result_factory=self.result_factory,
+                value_of=self.value_of,
+                enclosing=cfg, role=Role.PLQ, farm_kind="seq")[0]
+            wlq = WinSeqLogic(
+                self.wlq, wlq_win, wlq_slide, WinType.CB,
+                result_factory=self.result_factory,
+                config=WinOperatorConfig(cfg.id_inner, cfg.n_inner,
+                                         cfg.slide_inner, 0, 1,
+                                         wlq_slide),
+                role=Role.WLQ)
+        else:
+            plq = WinSeqLogic(
+                self.plq, pane, pane, self.win_type,
+                triggering_delay=self.triggering_delay,
+                result_factory=self.result_factory,
+                config=WinOperatorConfig(cfg.id_inner, cfg.n_inner,
+                                         cfg.slide_inner, 0, 1, pane),
+                role=Role.PLQ)
+            wlq = _tpu_replicas(
+                self.wlq, wlq_win, wlq_slide, WinType.CB, 1,
+                batch_len=self.batch_len, triggering_delay=0,
+                result_factory=self.result_factory,
+                value_of=self.value_of,
+                enclosing=cfg, role=Role.WLQ, farm_kind="seq")[0]
+        return [StageSpec(
+            f"{self.name}_fused", [ChainedLogic(plq, wlq)],
+            StandardEmitter(), RoutingMode.FORWARD,
+            ordering_mode=(OrderingMode.ID if self.win_type == WinType.CB
+                           else OrderingMode.TS))]
+
     def stages(self):
+        if (self.opt_level != OptLevel.LEVEL0
+                and self.plq_par == 1 and self.wlq_par == 1):
+            return self._fused_stage()
         cfg = self.config
         pane = self.pane_len
         stages = []

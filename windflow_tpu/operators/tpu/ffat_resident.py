@@ -9,11 +9,16 @@ lifted leaves plus their root paths -- the circular-buffer tree update
 of the reference (win_seqffat_gpu.hpp:150 ``rebuild`` flag;
 UpdateTreeLevel_Kernel, flatfat_gpu.hpp:68-82).
 
-Scope: count-based windows over per-key arrival order (one tuple per
-leaf; ring position = arrival index mod capacity).  Time-based streams
-keep the rebuild path (the builder routes them there).  Ring capacity
-is sized to win_len + chunk headroom, and every svc call fires + queries
-due windows before their leaves can be overwritten.
+Scope: CB windows over per-key arrival order (one tuple per leaf; ring
+position = arrival index mod capacity), and TB windows over per-key
+IN-ORDER timestamps -- ring eviction is keyed on the timestamp proof
+that every window covering a leaf has fired (positions below
+``searchsorted(ts, next_fire * slide)`` are dead), and the leaf ring
+grows when a window span holds more tuples than the current capacity
+(win_seqffat_gpu.hpp:444-...).  Out-of-order TB streams keep the
+rebuild path.  Ring capacity starts at win_len + chunk headroom, and
+every svc call fires + queries due windows before their leaves can be
+overwritten.
 """
 from __future__ import annotations
 
@@ -30,20 +35,32 @@ from ..base import Operator, StageSpec
 
 
 class _ResidentKey:
-    __slots__ = ("row", "count", "next_fire", "ts_ring")
+    __slots__ = ("row", "count", "next_fire", "ts_ring",
+                 "ts_vals", "ts_base", "max_ts", "anchored")
 
-    def __init__(self, row: int, capacity: int):
+    def __init__(self, row: int, capacity: int, tb: bool = False):
         self.row = row
         self.count = 0      # tuples received = next leaf id
         self.next_fire = 0  # next window (lwid) to fire
-        # host-side timestamp ring mirroring the leaf ring, so CB
-        # results carry the last-extent-tuple ts like every other path
-        self.ts_ring = np.zeros(capacity, np.int64)
+        if tb:
+            # TB: host mirror of the leaf timestamps at absolute
+            # positions [ts_base, count), for extent binary search and
+            # the eviction proof
+            self.ts_vals = np.empty(0, np.int64)
+            self.ts_base = 0
+            self.max_ts = -1
+            self.anchored = False
+        else:
+            # host-side timestamp ring mirroring the leaf ring, so CB
+            # results carry the last-extent-tuple ts like every other
+            # path
+            self.ts_ring = np.zeros(capacity, np.int64)
 
 
 class WinSeqFFATResidentLogic(NodeLogic):
     def __init__(self, lift: Callable, combine: Callable, neutral: float,
                  win_len: int, slide_len: int, *,
+                 win_type: WinType = WinType.CB,
                  result_factory=BasicRecord, initial_keys: int = 16):
         from ...ops.flatfat_jax import BatchedFlatFAT
         if win_len == 0 or slide_len == 0:
@@ -53,8 +70,12 @@ class WinSeqFFATResidentLogic(NodeLogic):
         self.neutral = float(neutral)
         self.win_len = win_len
         self.slide_len = slide_len
+        self.win_type = win_type
+        self.is_tb = win_type == WinType.TB
         self.result_factory = result_factory
-        # capacity: window span + one slide of update headroom, pow2
+        # capacity: window span + one slide of update headroom, pow2.
+        # CB: exact (one leaf per id).  TB: a starting estimate -- the
+        # ring grows when a window span holds more tuples than this.
         need = win_len + slide_len
         self._chunk_headroom = max(slide_len, 1024)
         n = 1
@@ -72,7 +93,8 @@ class WinSeqFFATResidentLogic(NodeLogic):
             row = len(self.keys)
             if row >= self.forest.n_keys:
                 self._grow_forest()
-            st = self.keys[key] = _ResidentKey(row, self.capacity)
+            st = self.keys[key] = _ResidentKey(row, self.capacity,
+                                               self.is_tb)
         return st
 
     def _grow_forest(self) -> None:
@@ -84,6 +106,28 @@ class WinSeqFFATResidentLogic(NodeLogic):
                                      old.shape[0] * 2, self.capacity)
         self.forest.tree = jnp.concatenate(
             [old, jnp.full(old.shape, self.neutral, old.dtype)])
+
+    def _grow_leaves(self, min_capacity: int) -> None:
+        """TB ring overflow: a retained window span no longer fits the
+        leaf ring.  Double the capacity and re-scatter every key's live
+        leaves at their new ring positions (the circular-buffer resize
+        of win_seqffat_gpu.hpp:444-...; rare, amortized O(1))."""
+        assert self.is_tb, "CB rings are capacity-exact by construction"
+        from ...ops.flatfat_jax import BatchedFlatFAT
+        old_n = self.forest.n
+        old_leaves = np.asarray(self.forest.tree)[:, old_n:2 * old_n]
+        n = old_n
+        while n < min_capacity:
+            n <<= 1
+        self.capacity = n
+        self.forest = BatchedFlatFAT(self.combine, self.neutral,
+                                     self.forest.n_keys, n)
+        for st in self.keys.values():
+            live = np.arange(st.ts_base, st.count)
+            for c in range(0, len(live), 4096):
+                pos = live[c:c + 4096]
+                self.forest.update(np.full(len(pos), st.row), pos,
+                                   old_leaves[st.row, pos % old_n])
 
     # -- ingest --------------------------------------------------------
     def _ingest_chunk(self, rows, ids, lifted, key_objs, emit) -> None:
@@ -122,6 +166,93 @@ class WinSeqFFATResidentLogic(NodeLogic):
             out.set_control_fields(key, lwid, rts)
             emit(out)
 
+    # -- TB plane: timestamp-proof ring eviction -----------------------
+    def _ingest_tb(self, key, tss, vals, emit) -> None:
+        st = self._key_state(key)
+        # compare against max_ts, not the mirror tail: full mirror
+        # eviction would otherwise make the guard vacuous and silently
+        # drop a late tuple
+        if not np.all(tss[:-1] <= tss[1:]) or (
+                st.max_ts >= 0 and tss[0] < st.max_ts):
+            raise ValueError(
+                "resident TB FFAT requires per-key in-order timestamps; "
+                "use the rebuild path (WinSeqFFATTPU) for out-of-order "
+                "streams")
+        if not st.anchored:
+            # anchor the fire frontier at the first containing window
+            first = int(tss[0])
+            st.next_fire = (0 if first < self.win_len
+                            else (first - self.win_len)
+                            // self.slide_len + 1)
+            st.anchored = True
+        step = self._chunk_headroom
+        for c in range(0, len(tss), step):
+            d = min(c + step, len(tss))
+            # timestamp proof: leaves with ts below the fired frontier
+            # are dead (every window covering them already fired); if
+            # the live span plus this chunk overflows the ring, grow it
+            dead = st.ts_base + int(np.searchsorted(
+                st.ts_vals, st.next_fire * self.slide_len, "left"))
+            live_after = st.count + (d - c) - dead
+            if live_after > self.capacity:
+                # slice every mirror to its exact dead frontier first so
+                # [ts_base, count) spans <= capacity per key and old
+                # ring positions are alias-free for the re-scatter
+                for st2 in self.keys.values():
+                    d2 = int(np.searchsorted(
+                        st2.ts_vals, st2.next_fire * self.slide_len,
+                        "left"))
+                    st2.ts_vals = st2.ts_vals[d2:]
+                    st2.ts_base += d2
+                self._grow_leaves(int(live_after) + self._chunk_headroom)
+            ids = np.arange(st.count, st.count + (d - c))
+            st.ts_vals = np.concatenate([st.ts_vals, tss[c:d]])
+            st.count += d - c
+            st.max_ts = int(tss[d - 1])
+            self.forest.update(np.full(d - c, st.row), ids,
+                               vals[c:d].astype(np.float32))
+            self._fire_tb(key, st, emit)
+
+    def _fire_tb(self, key, st, emit, at_eos: bool = False) -> None:
+        rows, qs, qe, meta = [], [], [], []
+        while True:
+            s_ts = st.next_fire * self.slide_len
+            if at_eos:
+                if s_ts > st.max_ts:
+                    break
+            elif st.max_ts < s_ts + self.win_len:
+                break
+            sp = st.ts_base + int(np.searchsorted(st.ts_vals, s_ts,
+                                                  "left"))
+            ep = st.ts_base + int(np.searchsorted(
+                st.ts_vals, s_ts + self.win_len, "left"))
+            rows.append(st.row)
+            qs.append(sp)
+            qe.append(ep)
+            # TB result ts is window arithmetic, like every other engine
+            meta.append((key, st.next_fire,
+                         s_ts + self.win_len - 1))
+            st.next_fire += 1
+        if rows:
+            res = self.forest.query(np.asarray(rows), np.asarray(qs),
+                                    np.asarray(qe))
+            self.launched_batches += 1
+            if self.stats is not None:
+                self.stats.num_launches += 1
+                self.stats.bytes_from_device += res.nbytes
+            for (key_, lwid, rts), s_, e_, val in zip(meta, qs, qe, res):
+                out = self.result_factory()
+                out.value = float(val) if e_ > s_ else 0.0  # masked
+                out.set_control_fields(key_, lwid, rts)
+                emit(out)
+            # amortized mirror eviction at the fired frontier
+            dead = int(np.searchsorted(st.ts_vals,
+                                       st.next_fire * self.slide_len,
+                                       "left"))
+            if dead > 1024:
+                st.ts_vals = st.ts_vals[dead:]
+                st.ts_base += dead
+
     def svc(self, item, channel_id, emit):
         if isinstance(item, EOSMarker):
             return
@@ -139,8 +270,11 @@ class WinSeqFFATResidentLogic(NodeLogic):
             step = self._chunk_headroom
             for j in range(len(bounds) - 1):
                 key = keys[bounds[j]].item()
-                st = self._key_state(key)
                 lo, hi = int(bounds[j]), int(bounds[j + 1])
+                if self.is_tb:
+                    self._ingest_tb(key, tss[lo:hi], vals[lo:hi], emit)
+                    continue
+                st = self._key_state(key)
                 for c in range(lo, hi, step):
                     d = min(c + step, hi)
                     ids = np.arange(st.count, st.count + (d - c))
@@ -151,8 +285,12 @@ class WinSeqFFATResidentLogic(NodeLogic):
                         vals[c:d].astype(np.float32), [key], emit)
             return
         key, _tid, ts = item.get_control_fields()
-        st = self._key_state(key)
         lifted = self.lift(item)
+        if self.is_tb:
+            self._ingest_tb(key, np.array([ts]),
+                            np.array([lifted], np.float64), emit)
+            return
+        st = self._key_state(key)
         st.ts_ring[st.count % self.capacity] = ts
         st.count += 1
         self._ingest_chunk([st.row], [st.count - 1], [lifted], [key], emit)
@@ -160,6 +298,11 @@ class WinSeqFFATResidentLogic(NodeLogic):
     def eos_flush(self, emit):
         """Fire partial tail windows whose extent clips at the stream
         end (the EOS flush of open windows, win_seq.hpp:514-579)."""
+        if self.is_tb:
+            for key, st in self.keys.items():
+                if st.max_ts >= 0:
+                    self._fire_tb(key, st, emit, at_eos=True)
+            return
         rows, qs, qe, meta = [], [], [], []
         for key, st in self.keys.items():
             while st.next_fire * self.slide_len < st.count:
@@ -175,15 +318,22 @@ class WinSeqFFATResidentLogic(NodeLogic):
 
     # -- checkpoint ----------------------------------------------------
     def state_dict(self):
-        return {"keys": {k: (st.row, st.count, st.next_fire,
-                             st.ts_ring.copy())
-                         for k, st in self.keys.items()},
-                "tree": np.asarray(self.forest.tree)}
+        if self.is_tb:
+            keys = {k: (st.row, st.count, st.next_fire,
+                        st.ts_vals.copy(), st.ts_base, st.max_ts,
+                        st.anchored)
+                    for k, st in self.keys.items()}
+        else:
+            keys = {k: (st.row, st.count, st.next_fire, st.ts_ring.copy())
+                    for k, st in self.keys.items()}
+        return {"keys": keys, "tree": np.asarray(self.forest.tree),
+                "capacity": self.capacity}
 
     def load_state(self, state):
         import jax.numpy as jnp
         from ...ops.flatfat_jax import BatchedFlatFAT
         tree = state["tree"]
+        self.capacity = state.get("capacity", self.capacity)
         # the forest must match the snapshot's row count EXACTLY: a
         # larger n_keys would let jnp clamp out-of-range rows silently,
         # aliasing new keys onto the last checkpointed tree
@@ -191,10 +341,14 @@ class WinSeqFFATResidentLogic(NodeLogic):
                                      tree.shape[0], self.capacity)
         self.forest.tree = jnp.asarray(tree)
         self.keys.clear()
-        for k, (row, count, nf, ts_ring) in state["keys"].items():
-            st = _ResidentKey(row, self.capacity)
-            st.count, st.next_fire = count, nf
-            st.ts_ring = np.asarray(ts_ring).copy()
+        for k, fields in state["keys"].items():
+            st = _ResidentKey(fields[0], self.capacity, self.is_tb)
+            st.count, st.next_fire = fields[1], fields[2]
+            if self.is_tb:
+                st.ts_vals = np.asarray(fields[3]).copy()
+                st.ts_base, st.max_ts, st.anchored = fields[4:7]
+            else:
+                st.ts_ring = np.asarray(fields[3]).copy()
             self.keys[k] = st
 
 
@@ -202,14 +356,19 @@ class WinSeqFFATResident(Operator):
     """Standalone resident-tree FFAT operator (rebuild=false mode)."""
 
     def __init__(self, lift, combine, neutral, win_len, slide_len,
+                 win_type: WinType = WinType.CB,
                  name="win_seqffat_resident", result_factory=BasicRecord):
         super().__init__(name, 1, RoutingMode.FORWARD,
                          Pattern.WIN_SEQFFAT_TPU)
+        self.win_type = win_type
         self.kwargs = dict(lift=lift, combine=combine, neutral=neutral,
                            win_len=win_len, slide_len=slide_len,
-                           result_factory=result_factory)
+                           win_type=win_type, result_factory=result_factory)
 
     def stages(self):
         logic = WinSeqFFATResidentLogic(**self.kwargs)
         return [StageSpec(self.name, [logic], StandardEmitter(),
-                          self.routing, ordering_mode=OrderingMode.ID)]
+                          self.routing,
+                          ordering_mode=(OrderingMode.ID
+                                         if self.win_type == WinType.CB
+                                         else OrderingMode.TS))]

@@ -16,8 +16,10 @@ tail panes of the next epoch, so every window is emitted exactly once.
 Keys advance through epochs independently; each launch groups keys at
 the same epoch (rows padded to the mesh's key-axis multiple).
 
-Scope: builtin ``sum`` windows over dense per-key ids (CB) or
-timestamps (TB); win/slide must be pane-aligned multiples.
+Scope: builtin ``sum``/``count``/``max``/``min`` or FFAT lift+combine
+windows (``mean`` is rejected: pane partials carry no count channel)
+over dense per-key ids (CB) or timestamps (TB); win/slide must be
+pane-aligned multiples.
 """
 from __future__ import annotations
 
@@ -94,14 +96,13 @@ class PaneFarmMeshLogic(NodeLogic):
         # half of the reference's combine contract,
         # flatfat_gpu.hpp:68-82) -- log2(n) array-level combine calls
         # per chunk, not one scalar dispatch per tuple
+        from ...parallel.sharded import pairwise_fold
         seq = np.asarray(self.lift(vals) if self.lift is not None
                          else vals, np.float64)
-        while len(seq) > 1:
-            if len(seq) % 2:
-                seq = np.append(seq, self.neutral)
-            seq = np.asarray(self.combine(seq[0::2], seq[1::2]))
-        return float(self.combine(partial, seq[0])) if len(seq) \
-            else partial
+        if not len(seq):
+            return partial
+        return float(self.combine(
+            partial, pairwise_fold(seq, self.combine, self.neutral, np)))
 
     # -- host PLQ: pane pre-reduction ---------------------------------
     def _ingest_key(self, key, ids, vals) -> None:

@@ -103,6 +103,19 @@ def _sharded_programs(mesh_id: int, win_len: int, slide_len: int):
 _MESHES: Dict[int, Any] = {}
 
 
+def pairwise_fold(x, combine, neutral, xp):
+    """Log-depth pairwise combine tree along the LAST axis (associative
+    by the FFAT contract).  ``xp`` is numpy for the host PLQ or
+    jax.numpy inside a traced program -- one implementation serves both
+    halves of the __host__ __device__ combine contract."""
+    while x.shape[-1] > 1:
+        if x.shape[-1] % 2:
+            pad = xp.full(x.shape[:-1] + (1,), neutral, x.dtype)
+            x = xp.concatenate([x, pad], axis=-1)
+        x = xp.asarray(combine(x[..., 0::2], x[..., 1::2]))
+    return x[..., 0]
+
+
 def _resolve_kind(kind):
     """Normalize a mesh combine spec to (name, combine, neutral, lift).
 
@@ -223,15 +236,8 @@ class ShardedWindowEngine:
                     return jnp.max(x, axis=axis)
                 if kind == "min":
                     return jnp.min(x, axis=axis)
-                x = jnp.moveaxis(x, axis, -1)
-                while x.shape[-1] > 1:
-                    n = x.shape[-1]
-                    if n % 2:
-                        pad = jnp.full(x.shape[:-1] + (1,), neutral,
-                                       x.dtype)
-                        x = jnp.concatenate([x, pad], axis=-1)
-                    x = comb(x[..., 0::2], x[..., 1::2])
-                return x[..., 0]
+                return pairwise_fold(jnp.moveaxis(x, axis, -1), comb,
+                                     neutral, jnp)
 
             def ring_shard(pane_vals):
                 # [K, P_loc, pane_len] per shard

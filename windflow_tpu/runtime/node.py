@@ -56,6 +56,49 @@ class NodeLogic:
         raise NotImplementedError(f"{type(self).__name__} is stateless")
 
 
+class ChainedLogic(NodeLogic):
+    """Thread fusion of two logics: b consumes a's emissions inline
+    (the reference's combine_with_laststage, multipipe.hpp:381, and the
+    ff_comb PLQ/WLQ fusion of optimize_PaneFarm, pane_farm.hpp:222-250)."""
+
+    def __init__(self, a: NodeLogic, b: NodeLogic):
+        self.a = a
+        self.b = b
+
+    def svc_init(self):
+        # the RtNode attaches the replica StatsRecord to the OUTER
+        # logic only; forward it so fused stages report device metrics
+        self.a.stats = self.stats
+        self.b.stats = self.stats
+        self.a.svc_init()
+        self.b.svc_init()
+
+    def svc(self, item, channel_id, emit):
+        self.a.svc(item, channel_id,
+                   lambda x: self.b.svc(x, 0, emit))
+
+    def eos_flush(self, emit):
+        self.a.eos_flush(lambda x: self.b.svc(x, 0, emit))
+        self.b.eos_flush(emit)
+
+    def svc_end(self):
+        self.a.svc_end()
+        self.b.svc_end()
+
+    # -- checkpoint: delegate to both halves ---------------------------
+    def state_dict(self):
+        sa, sb = self.a.state_dict(), self.b.state_dict()
+        if sa is None and sb is None:
+            return None
+        return {"a": sa, "b": sb}
+
+    def load_state(self, state):
+        if state.get("a") is not None:
+            self.a.load_state(state["a"])
+        if state.get("b") is not None:
+            self.b.load_state(state["b"])
+
+
 class Outlet:
     """Output side of a node: an emitter routing items to destination
     channels.  ``dests`` is a list of (channel, producer_id)."""
