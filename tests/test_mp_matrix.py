@@ -333,3 +333,37 @@ def test_cb_default_renumbering_tumbling(kind):
         g.run()
         totals.append(sink.total)
     assert totals[0] == totals[1] == expected_total(PER_KEY, N_KEYS, 10, 10)
+
+
+@pytest.mark.parametrize("kind", ["wf", "wf+pf"])
+def test_cb_broadcast_plane_filtered_prefix(kind):
+    """CB windows entering a WF-multicast stage behind a FILTERING
+    prefix: upstream ids are not per-key dense, so id-based multicast
+    membership is wrong -- the broadcast + TS-renumbering plane
+    (multipipe.hpp:1039-1051) must yield windows over the arrival-dense
+    renumbered ids of the surviving tuples."""
+    def keep(t):
+        return t.value % 3 != 0  # drop every third value
+
+    per_key = 90
+    survivors = [float(v) for v in range(per_key) if v % 3 != 0]
+
+    def expect_total():
+        total, g = 0.0, 0
+        while g * SLIDE < len(survivors):
+            total += sum(survivors[g * SLIDE: g * SLIDE + WIN])
+            g += 1
+        return total * N_KEYS
+
+    totals = []
+    for par in (2, 3):
+        sink = SumSink()
+        g = wf.PipeGraph("cbf", Mode.DETERMINISTIC)
+        op = build_window_op(kind, WinType.CB, par, random.Random(0))
+        g.add_source(wf.SourceBuilder(
+            ordered_keyed_stream(N_KEYS, per_key)).build()) \
+            .add(wf.FilterBuilder(keep).build()) \
+            .add(op).add_sink(wf.SinkBuilder(sink).build())
+        g.run()
+        totals.append(sink.total)
+    assert totals[0] == totals[1] == expect_total()

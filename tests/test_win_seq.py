@@ -122,3 +122,63 @@ def test_win_seq_deterministic_mode_parallel_prefix():
         g.run()
         totals.append(sum(r[3] for r in coll.results))
     assert totals[0] == totals[1] == n_keys * sum(range(per_key))
+
+
+def test_large_first_id_anchors_all_engines():
+    """A first tuple with an epoch-scale id/ts anchors window creation
+    at its first containing window on EVERY plane (native engine parity
+    for the Python record plane, the columnar TPU plane, and both
+    resident-FFAT rebuild modes): no ~id/slide empty leading windows,
+    identical window sets across engines."""
+    import threading
+    import windflow_tpu as wf
+    from windflow_tpu.core import Mode, WinType
+    from windflow_tpu.core.tuples import BasicRecord
+
+    OFF, N, WINL, SL = 100_000, 40, 8, 8
+
+    def src():
+        state = {"i": 0}
+
+        def fn(shipper, ctx):
+            i = state["i"]
+            if i >= N:
+                return False
+            shipper.push(BasicRecord(0, OFF + i, OFF + i, 1.0))
+            state["i"] = i + 1
+            return True
+
+        return fn
+
+    def run(op):
+        got = {}
+        lock = threading.Lock()
+
+        def sink(rec):
+            if rec is not None:
+                with lock:
+                    got[rec.get_control_fields()[1]] = rec.value
+
+        g = wf.PipeGraph("anchor", Mode.DEFAULT)
+        g.add_source(wf.SourceBuilder(src()).build()) \
+            .add(op).add_sink(wf.SinkBuilder(sink).build())
+        g.run()
+        return got
+
+    ops = {
+        "win_seq": wf.WinSeqBuilder(
+            lambda gwid, it, res: setattr(
+                res, "value", sum(t.value for t in it))) \
+            .with_tb_windows(WINL, SL).build(),
+        "win_seq_tpu": wf.WinSeqTPUBuilder("sum")
+            .with_tb_windows(WINL, SL).build(),
+        "ffat_rebuild": wf.WinSeqFFATTPUBuilder(lambda t: t.value, "sum")
+            .with_tb_windows(WINL, SL).build(),
+        "ffat_resident": wf.WinSeqFFATTPUBuilder(lambda t: t.value, "sum")
+            .with_tb_windows(WINL, SL).with_rebuild(False).build(),
+    }
+    results = {name: run(op) for name, op in ops.items()}
+    w0 = OFF // SL  # anchor window (tumbling; first ts on a boundary)
+    expect = {w0 + j: 8.0 for j in range(N // SL)}
+    for name, got in results.items():
+        assert got == expect, (name, min(got, default=None), len(got))

@@ -67,7 +67,9 @@ class MultiPipe:
             return OrderingLogic(ordering_mode, n_channels)
         # PROBABILISTIC: K-slack; CB windows additionally need dense ids
         km = (OrderingMode.TS_RENUMBERING
-              if ordering_mode == OrderingMode.ID else OrderingMode.TS)
+              if ordering_mode in (OrderingMode.ID,
+                                   OrderingMode.TS_RENUMBERING)
+              else OrderingMode.TS)
         return KSlackLogic(km, on_drop=self.graph._count_dropped)
     def _append_stage(self, stage: StageSpec,
                       win_type: Optional[WinType] = None):
@@ -213,9 +215,40 @@ class MultiPipe:
         if (self.graph.mode == Mode.DEFAULT and win_type == WinType.CB
                 and hasattr(op, "enable_renumbering")):
             op.enable_renumbering()
-        for stage in op.stages():
+        for i, stage in enumerate(op.stages()):
+            if i == 0:
+                self._swap_cb_broadcast(stage, win_type)
             self._append_stage(stage, win_type)
         return self
+
+    def _swap_cb_broadcast(self, stage: StageSpec, win_type) -> None:
+        """CB windows entering a window-multicast (WF-rooted) stage in
+        DETERMINISTIC/PROBABILISTIC mode: the upstream ids need not be
+        per-key dense (filters upstream drop tuples), so id-based
+        multicast membership is wrong.  The reference swaps the emitter
+        for a Broadcast_Emitter and renumbers densely in per-replica
+        TS-ordering collectors (multipipe.hpp:1039-1051); each replica
+        then keeps only the windows its config owns."""
+        from ..core.basic import Role
+        from ..runtime.emitters import BroadcastEmitter, TreeEmitter
+        from ..runtime.win_routing import WFEmitter
+        if (self.graph.mode == Mode.DEFAULT or win_type != WinType.CB
+                or stage.routing != RoutingMode.COMPLEX):
+            return
+        em = stage.emitter_proto
+        root = em.root if isinstance(em, TreeEmitter) else em
+        if not isinstance(root, WFEmitter):
+            return
+        # MAP stages distribute by per-key round-robin STRIPING, not by
+        # window membership: workers do not self-select stripes, so the
+        # broadcast plane does not apply (Win_MapReduce keeps its
+        # emitter tree)
+        if any(getattr(r, "role", None) == Role.MAP
+               for r in stage.replicas):
+            return
+        stage.emitter_proto = BroadcastEmitter()
+        stage.group_emitters = None
+        stage.ordering_mode = OrderingMode.TS_RENUMBERING
 
     def chain(self, op: Operator) -> "MultiPipe":
         """Thread-fuse a FORWARD operator into the current tail nodes when

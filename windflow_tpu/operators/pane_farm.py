@@ -89,6 +89,10 @@ class PaneFarm(Operator):
             role=Role.WLQ)
         return plq, wlq
 
+    # (both par-1 stage branches and the LEVEL1/2 fusion build their
+    # logics through _fused_logics, so the config arithmetic and the
+    # incremental flags live in exactly one place)
+
     def stages(self):
         if (self.opt_level != OptLevel.LEVEL0
                 and self.plq_parallelism == 1
@@ -103,6 +107,9 @@ class PaneFarm(Operator):
                                else OrderingMode.TS))]
         cfg = self.config
         pane = self.pane_len
+        # par-1 stages reuse the same logic construction as the fusion
+        # path -- one place owns the config arithmetic
+        plq_single, wlq_single = self._fused_logics()
         stages = []
         # ---- PLQ: tumbling panes (pane_farm.hpp:181-196) ----
         if self.plq_parallelism > 1:
@@ -117,17 +124,9 @@ class PaneFarm(Operator):
                           role=Role.PLQ)
             stages.extend(plq.stages())
         else:
-            logic = WinSeqLogic(
-                self.plq_func, pane, pane, self.win_type,
-                triggering_delay=self.triggering_delay,
-                incremental=self.plq_incremental,
-                result_factory=self.result_factory,
-                closing_func=self.closing_func,
-                config=WinOperatorConfig(cfg.id_inner, cfg.n_inner,
-                                         cfg.slide_inner, 0, 1, pane),
-                role=Role.PLQ)
             stages.append(StageSpec(
-                f"{self.name}_plq", [logic], StandardEmitter(), RoutingMode.FORWARD,
+                f"{self.name}_plq", [plq_single], StandardEmitter(),
+                RoutingMode.FORWARD,
                 ordering_mode=(OrderingMode.ID if self.win_type == WinType.CB
                                else OrderingMode.TS)))
         # ---- WLQ: CB windows over dense pane ids (pane_farm.hpp:198-214) ----
@@ -145,15 +144,7 @@ class PaneFarm(Operator):
                           role=Role.WLQ)
             stages.extend(wlq.stages())
         else:
-            logic = WinSeqLogic(
-                self.wlq_func, wlq_win, wlq_slide, WinType.CB,
-                incremental=self.wlq_incremental,
-                result_factory=self.result_factory,
-                closing_func=self.closing_func,
-                config=WinOperatorConfig(cfg.id_inner, cfg.n_inner,
-                                         cfg.slide_inner, 0, 1, wlq_slide),
-                role=Role.WLQ)
             stages.append(StageSpec(
-                f"{self.name}_wlq", [logic], StandardEmitter(keyed=True),
+                f"{self.name}_wlq", [wlq_single], StandardEmitter(keyed=True),
                 RoutingMode.KEYBY, ordering_mode=OrderingMode.ID))
         return stages

@@ -188,6 +188,24 @@ class PaneFarmTPU(_TPUWinOp):
         self.config = config or WinOperatorConfig(0, 1, slide_len,
                                                   0, 1, slide_len)
 
+    def _device_single(self, kind, win, slide, win_type, role, delay):
+        """One device engine replica (shared by the fused path and the
+        par-1 stage branches -- the config arithmetic lives here)."""
+        return _tpu_replicas(
+            kind, win, slide, win_type, 1, batch_len=self.batch_len,
+            triggering_delay=delay, result_factory=self.result_factory,
+            value_of=self.value_of, enclosing=self.config, role=role,
+            farm_kind="seq")[0]
+
+    def _host_single(self, fn, win, slide, win_type, role, delay=0):
+        cfg = self.config
+        return WinSeqLogic(
+            fn, win, slide, win_type, triggering_delay=delay,
+            result_factory=self.result_factory,
+            config=WinOperatorConfig(cfg.id_inner, cfg.n_inner,
+                                     cfg.slide_inner, 0, 1, slide),
+            role=role)
+
     def _fused_stage(self):
         """LEVEL1/2 single/single thread fusion (ff_comb of
         optimize_PaneFarm, pane_farm.hpp:222-250): the device stage and
@@ -195,39 +213,19 @@ class PaneFarmTPU(_TPUWinOp):
         async dispatcher keeps overlapping launches; the chained
         consumer runs on whichever thread flushes the batch."""
         from ...runtime.node import ChainedLogic
-        cfg = self.config
         pane = self.pane_len
         wlq_win = self.win_len // pane
         wlq_slide = self.slide_len // pane
         if self.plq_on_tpu:
-            plq = _tpu_replicas(
-                self.plq, pane, pane, self.win_type, 1,
-                batch_len=self.batch_len,
-                triggering_delay=self.triggering_delay,
-                result_factory=self.result_factory,
-                value_of=self.value_of,
-                enclosing=cfg, role=Role.PLQ, farm_kind="seq")[0]
-            wlq = WinSeqLogic(
-                self.wlq, wlq_win, wlq_slide, WinType.CB,
-                result_factory=self.result_factory,
-                config=WinOperatorConfig(cfg.id_inner, cfg.n_inner,
-                                         cfg.slide_inner, 0, 1,
-                                         wlq_slide),
-                role=Role.WLQ)
+            plq = self._device_single(self.plq, pane, pane, self.win_type,
+                                      Role.PLQ, self.triggering_delay)
+            wlq = self._host_single(self.wlq, wlq_win, wlq_slide,
+                                    WinType.CB, Role.WLQ)
         else:
-            plq = WinSeqLogic(
-                self.plq, pane, pane, self.win_type,
-                triggering_delay=self.triggering_delay,
-                result_factory=self.result_factory,
-                config=WinOperatorConfig(cfg.id_inner, cfg.n_inner,
-                                         cfg.slide_inner, 0, 1, pane),
-                role=Role.PLQ)
-            wlq = _tpu_replicas(
-                self.wlq, wlq_win, wlq_slide, WinType.CB, 1,
-                batch_len=self.batch_len, triggering_delay=0,
-                result_factory=self.result_factory,
-                value_of=self.value_of,
-                enclosing=cfg, role=Role.WLQ, farm_kind="seq")[0]
+            plq = self._host_single(self.plq, pane, pane, self.win_type,
+                                    Role.PLQ, self.triggering_delay)
+            wlq = self._device_single(self.wlq, wlq_win, wlq_slide,
+                                      WinType.CB, Role.WLQ, 0)
         return [StageSpec(
             f"{self.name}_fused", [ChainedLogic(plq, wlq)],
             StandardEmitter(), RoutingMode.FORWARD,
@@ -305,15 +303,11 @@ class PaneFarmTPU(_TPUWinOp):
                               Role.WLQ)
                 stages.extend(wlq.stages())
             else:
-                logic = WinSeqLogic(
-                    self.wlq, wlq_win, wlq_slide, WinType.CB,
-                    result_factory=self.result_factory,
-                    config=WinOperatorConfig(cfg.id_inner, cfg.n_inner,
-                                             cfg.slide_inner, 0, 1,
-                                             wlq_slide),
-                    role=Role.WLQ)
                 stages.append(StageSpec(
-                    f"{self.name}_wlq", [logic], StandardEmitter(keyed=True),
+                    f"{self.name}_wlq",
+                    [self._host_single(self.wlq, wlq_win, wlq_slide,
+                                       WinType.CB, Role.WLQ)],
+                    StandardEmitter(keyed=True),
                     RoutingMode.KEYBY, ordering_mode=OrderingMode.ID))
         return stages
 

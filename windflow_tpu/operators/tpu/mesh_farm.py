@@ -61,6 +61,12 @@ class KeyFarmMeshLogic(NodeLogic):
         st = self.keys.get(key)
         if st is None:
             st = self.keys[key] = _ShardKeyState()
+        if st.max_id < 0 and len(ids):
+            # anchor at the first containing window (native parity)
+            first = int(ids.min())
+            if first >= self.win_len:
+                st.next_fire = ((first - self.win_len)
+                                // self.slide_len + 1)
         keep = ids >= st.next_fire * self.slide_len
         ids, vals = ids[keep], vals[keep]
         if len(ids) == 0:

@@ -153,7 +153,7 @@ class _AsyncDispatcher:
 class _TPUKeyState:
     __slots__ = ("sort_keys", "ts", "values", "pending_sort", "pending_ts",
                  "pending_val", "pending_chunks", "next_fire", "opened_max",
-                 "max_id", "renumber_next", "emit_counter")
+                 "max_id", "renumber_next", "emit_counter", "anchor")
 
     def __init__(self, emit_counter_start=0):
         # consolidated sorted arrays
@@ -167,6 +167,9 @@ class _TPUKeyState:
         self.pending_val: List[float] = []
         self.pending_chunks: List = []
         self.next_fire = 0        # next lwid to fire
+        self.anchor = 0           # first window that can ever fire (set
+                                  # from the first tuple, like the
+                                  # native engine's anchor)
         self.opened_max = -1      # highest lwid opened by any tuple
         self.max_id = -1
         self.renumber_next = 0
@@ -625,9 +628,19 @@ class WinSeqTPULogic(NodeLogic):
                 k_ids = np.arange(st.renumber_next,
                                   st.renumber_next + (hi - lo))
                 st.renumber_next += hi - lo
+            if st.max_id < 0 and len(k_ids):
+                # first data: anchor the fire frontier at the first
+                # containing window (native-engine parity; an
+                # epoch-scale first id must not fire ~id/slide empty
+                # windows)
+                rel = int(k_ids.min()) - initial_id
+                if rel >= self.win_len:
+                    st.anchor = (rel - self.win_len) // self.slide_len + 1
+                    st.next_fire = st.anchor
             # acceptance: drop tuples behind the already-fired frontier
             min_boundary = (self.win_len + (st.next_fire - 1) * self.slide_len
-                            if st.next_fire > 0 else 0)
+                            if st.next_fire > st.anchor
+                            else st.anchor * self.slide_len)
             keep = k_ids >= initial_id + min_boundary
             if self.win_len < self.slide_len:  # hopping: drop gap tuples
                 n = (k_ids - initial_id) // self.slide_len
@@ -635,7 +648,7 @@ class WinSeqTPULogic(NodeLogic):
                 keep &= (off >= n * self.slide_len) & \
                     (off < n * self.slide_len + self.win_len)
             n_drop = int((~keep).sum())
-            if n_drop and st.next_fire > 0:
+            if n_drop and st.next_fire > st.anchor:
                 self.ignored_tuples += n_drop
             k_ids = k_ids[keep]
             if len(k_ids) == 0:
@@ -685,10 +698,16 @@ class WinSeqTPULogic(NodeLogic):
         cfg = self.config
         initial_id = wa.initial_id_of_key(hashcode, cfg, self.role)
         if not is_marker:
+            if st.max_id < 0:
+                rel = id_ - initial_id
+                if rel >= self.win_len:
+                    st.anchor = (rel - self.win_len) // self.slide_len + 1
+                    st.next_fire = st.anchor
             min_boundary = (self.win_len + (st.next_fire - 1) * self.slide_len
-                            if st.next_fire > 0 else 0)
+                            if st.next_fire > st.anchor
+                            else st.anchor * self.slide_len)
             if id_ < initial_id + min_boundary:
-                if st.next_fire > 0:
+                if st.next_fire > st.anchor:
                     self.ignored_tuples += 1
                 return
             last_w = wa.last_window_of(id_, initial_id, self.win_len,

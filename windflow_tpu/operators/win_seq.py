@@ -136,6 +136,16 @@ class WinSeqLogic(NodeLogic):
         cfg = self.config
         first_gwid_key = wa.first_gwid_of_key(hashcode, cfg)
         initial_id = wa.initial_id_of_key(hashcode, cfg, self.role)
+        # first tuple of this key: anchor window creation at its first
+        # containing window -- an epoch-scale first id/ts must not
+        # materialize ~id/slide empty leading windows (matches the
+        # native engine and the on-demand creation of win_seq.hpp:
+        # 417-428)
+        if (kd.next_lwid == 0 and kd.last_lwid < 0 and not kd.wins
+                and not is_marker):
+            rel = id_ - initial_id
+            if rel >= self.win_len:
+                kd.next_lwid = (rel - self.win_len) // self.slide_len + 1
         # ignore tuples predating the last fired window (win_seq.hpp:358-380)
         min_boundary = (self.win_len + kd.last_lwid * self.slide_len
                         if kd.last_lwid >= 0 else 0)
