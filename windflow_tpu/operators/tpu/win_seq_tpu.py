@@ -753,11 +753,23 @@ class WinSeqTPULogic(NodeLogic):
         self._launch(emit)
         self._drain_all(emit)
 
+    def quiesce(self, emit) -> bool:
+        """Live-checkpoint barrier hook (pipegraph.quiesce): drain every
+        in-flight device batch, emitting its results, so ``state_dict``
+        sees no pending work.  Returns True when anything was drained
+        (the barrier loops until a drain pass emits nothing).  Called
+        only while this node's thread is idle (sources paused, channels
+        empty), so touching engine state is safe."""
+        had = self._dispatcher is not None or bool(self.pending)
+        self._drain_all(emit)
+        return had
+
     # -- checkpoint / resume (utils/checkpoint.py policy layer) --------
     def state_dict(self):
         """Pickle-friendly snapshot (quiescent contract: no device
         batches in flight).  Native-path state is the engine's versioned
         binary blob; Python-path state is the per-key store."""
+        import copy
         st = {
             "descriptors": list(self.descriptors),
             "ignored_tuples": self.ignored_tuples,
@@ -767,7 +779,9 @@ class WinSeqTPULogic(NodeLogic):
         if self._native is not None:
             st["native"] = self._native.serialize()
         else:
-            st["keys"] = self.keys
+            # deep copy: a live checkpoint resumes the stream after the
+            # snapshot, and an aliased store would keep advancing
+            st["keys"] = copy.deepcopy(self.keys)
         return st
 
     def load_state(self, state):
@@ -786,7 +800,8 @@ class WinSeqTPULogic(NodeLogic):
                 raise RuntimeError(
                     "snapshot came from the Python path but this "
                     "replica runs the native engine")
-            self.keys = state["keys"]
+            import copy
+            self.keys = copy.deepcopy(state["keys"])
 
     def svc_end(self):
         # error-path teardown: eos_flush already drained (and cleared)

@@ -85,6 +85,18 @@ class ChainedLogic(NodeLogic):
         self.a.svc_end()
         self.b.svc_end()
 
+    def quiesce(self, emit) -> bool:
+        """Live-barrier hook: drain both halves' in-flight device work
+        (a's emissions feed b inline, exactly like svc)."""
+        emitted = False
+        qa = getattr(self.a, "quiesce", None)
+        if qa is not None:
+            emitted = bool(qa(lambda x: self.b.svc(x, 0, emit)))
+        qb = getattr(self.b, "quiesce", None)
+        if qb is not None:
+            emitted = bool(qb(emit)) or emitted
+        return emitted
+
     # -- checkpoint: delegate to both halves ---------------------------
     def state_dict(self):
         sa, sb = self.a.state_dict(), self.b.state_dict()
@@ -128,6 +140,37 @@ class Outlet:
             ch.close(pid)
 
 
+class SourcePauseControl:
+    """Cooperative source pause: the live-checkpoint barrier's first
+    phase.  Sources call ``gate()`` between generation steps; while a
+    pause is requested they ack and block until ``resume()``."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.pausing = False
+        self.paused_count = 0
+
+    def gate(self) -> None:
+        with self._cond:
+            if not self.pausing:
+                return
+            self.paused_count += 1
+            self._cond.notify_all()
+            while self.pausing:
+                self._cond.wait()
+            self.paused_count -= 1
+            self._cond.notify_all()
+
+    def request_pause(self) -> None:
+        with self._cond:
+            self.pausing = True
+
+    def resume(self) -> None:
+        with self._cond:
+            self.pausing = False
+            self._cond.notify_all()
+
+
 class RtNode(threading.Thread):
     """One operator replica = one host thread (FastFlow analogue; thread
     count report mirrors pipegraph.hpp:610-612)."""
@@ -141,6 +184,10 @@ class RtNode(threading.Thread):
         self.error: Optional[BaseException] = None
         self.stats = None  # StatsRecord when tracing is enabled
         self.group = None  # complex-nesting group id (multipipe grouping)
+        # drain detection for the live-checkpoint barrier: an item is
+        # in flight while taken != done
+        self.taken = 0
+        self.done = 0
 
     def _emit(self, item: Any) -> None:
         if self.stats is not None:
@@ -161,6 +208,7 @@ class RtNode(threading.Thread):
                     if got is None:
                         break
                     cid, item = got
+                    self.taken += 1
                     if stats is not None:
                         import time as _time
                         stats.inputs_received += 1
@@ -169,6 +217,7 @@ class RtNode(threading.Thread):
                         stats.observe((_time.perf_counter() - t0) * 1e6)
                     else:
                         self.logic.svc(item, cid, self._emit)
+                    self.done += 1
             self.logic.eos_flush(self._emit)
             if self.stats is not None:
                 self.stats.set_terminated()
@@ -191,7 +240,13 @@ class RtNode(threading.Thread):
 
 class SourceLoopLogic(NodeLogic):
     """Drives a generation function with no input channel: the function
-    is called until it returns False (reference source.hpp:175-252)."""
+    is called until it returns False (reference source.hpp:175-252).
+
+    ``pause_control`` (a SourcePauseControl, attached by
+    PipeGraph.start) gates every generation step so a live checkpoint
+    can halt production at a step boundary."""
+
+    pause_control = None
 
     def __init__(self, step: Callable[[Callable[[Any], None]], bool]):
         self.step = step
@@ -200,5 +255,9 @@ class SourceLoopLogic(NodeLogic):
         raise RuntimeError("source has no inputs")
 
     def eos_flush(self, emit):
-        while self.step(emit):
-            pass
+        while True:
+            ctl = self.pause_control
+            if ctl is not None:
+                ctl.gate()
+            if not self.step(emit):
+                break

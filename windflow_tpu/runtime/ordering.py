@@ -8,7 +8,6 @@ from __future__ import annotations
 
 import bisect
 import heapq
-import itertools
 from typing import Any, Callable, Dict, List, Optional
 
 from ..core.basic import OrderingMode
@@ -43,7 +42,10 @@ class OrderingLogic(NodeLogic):
         self.keys: Dict[Any, _KeyState] = {}
         self.global_heap: List = []
         self.global_maxs = [0] * n_channels
-        self._seq = itertools.count()  # unique tiebreaker (ptr compare in ref)
+        # unique tiebreaker (ptr compare in ref); a plain int, not
+        # itertools.count, so collector state pickles for the live
+        # checkpoint barrier
+        self._seq = 0
 
     def _key_state(self, key) -> _KeyState:
         st = self.keys.get(key)
@@ -57,6 +59,12 @@ class OrderingLogic(NodeLogic):
 
     def _emit_rec(self, rec, emit, is_marker=False):
         if self.mode == OrderingMode.TS_RENUMBERING:
+            # renumber a COPY: under the CB broadcast plane every
+            # replica's collector receives the SAME record object
+            # (BroadcastEmitter's immutability contract), and each
+            # assigns its own dense id
+            import copy
+            rec = copy.copy(rec)
             key = rec.get_control_fields()[0]
             st = self._key_state(key)
             rec.set_control_fields(key, st.emit_counter,
@@ -82,10 +90,28 @@ class OrderingLogic(NodeLogic):
             self.global_maxs[channel_id] = wid
             min_id = min(self.global_maxs)
             heap = self.global_heap
-        heapq.heappush(heap, (wid, next(self._seq), rec))
+        self._seq += 1
+        heapq.heappush(heap, (wid, self._seq, rec))
         while heap and heap[0][0] <= min_id:
             _, _, nxt = heapq.heappop(heap)
             self._emit_rec(nxt, emit)
+
+    # live-checkpoint snapshots: buffered records are part of the
+    # in-flight stream and must survive a restore.  Deep copies on both
+    # sides: the resumed run keeps heappop-ing the live heaps, and an
+    # aliased snapshot would decay with it.
+    def state_dict(self):
+        import copy
+        return {"keys": copy.deepcopy(self.keys),
+                "global_heap": copy.deepcopy(self.global_heap),
+                "global_maxs": list(self.global_maxs), "seq": self._seq}
+
+    def load_state(self, state):
+        import copy
+        self.keys = copy.deepcopy(state["keys"])
+        self.global_heap = copy.deepcopy(state["global_heap"])
+        self.global_maxs = list(state["global_maxs"])
+        self._seq = state["seq"]
 
     def eos_flush(self, emit):
         """Drain every queue in order, then re-publish the retained EOS
@@ -145,6 +171,8 @@ class KSlackLogic(NodeLogic):
                 continue
             self.last_timestamp = ts
             if self.mode == OrderingMode.TS_RENUMBERING:
+                import copy
+                rec = copy.copy(rec)  # shared under the broadcast plane
                 key = rec.get_control_fields()[0]
                 c = self.key_counters.get(key, 0)
                 self.key_counters[key] = c + 1
@@ -171,6 +199,29 @@ class KSlackLogic(NodeLogic):
         out, self.buffer = self.buffer[:cut], self.buffer[cut:]
         del self.buffer_ts[:cut]
         self._emit_in_order(out, emit)
+
+    def state_dict(self):
+        import copy
+        return {"K": self.K, "tcurr": self.tcurr,
+                "buffer_ts": list(self.buffer_ts),
+                "buffer": copy.deepcopy(self.buffer),
+                "ts_sample": list(self.ts_sample),
+                "last_timestamp": self.last_timestamp,
+                "dropped": self.dropped,
+                "dropped_records": list(self.dropped_records),
+                "key_counters": dict(self.key_counters)}
+
+    def load_state(self, state):
+        import copy
+        self.K = state["K"]
+        self.tcurr = state["tcurr"]
+        self.buffer_ts = list(state["buffer_ts"])
+        self.buffer = copy.deepcopy(state["buffer"])
+        self.ts_sample = list(state["ts_sample"])
+        self.last_timestamp = state["last_timestamp"]
+        self.dropped = state["dropped"]
+        self.dropped_records = list(state.get("dropped_records", []))
+        self.key_counters = dict(state["key_counters"])
 
     def eos_flush(self, emit):
         out, self.buffer = self.buffer, []
