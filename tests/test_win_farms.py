@@ -230,3 +230,79 @@ def test_pane_farm_level2_fusion(tpu, win_type):
     expect = oracle(48, 12, 4)
     assert colls[OptLevel.LEVEL0] == colls[OptLevel.LEVEL2] \
         == {k: expect for k in range(3)}
+
+
+def test_ordered_win_farm_epoch_timestamps_complete():
+    """An epoch-scale first timestamp anchors window ids far above 0;
+    the ordered collector must adopt the anchored base (not buffer the
+    whole stream) and every window must arrive."""
+    OFF, N, WINL, SL = 10_000_000_000, 20_000, 32, 16
+    import threading
+    from windflow_tpu.core.tuples import BasicRecord
+
+    state = {"i": 0}
+
+    def fn(shipper, ctx):
+        i = state["i"]
+        if i >= N:
+            return False
+        shipper.push(BasicRecord(0, OFF + i, OFF + i, 1.0))
+        state["i"] = i + 1
+        return True
+
+    got = {}
+    lock = threading.Lock()
+
+    def sink(rec):
+        if rec is not None:
+            with lock:
+                got[rec.get_control_fields()[1]] = rec.value
+
+    g = wf.PipeGraph("epoch", Mode.DEFAULT)
+    op = wf.WinFarmBuilder(sum_win).with_parallelism(3) \
+        .with_tb_windows(WINL, SL).build()
+    g.add_source(wf.SourceBuilder(fn).build()) \
+        .add(op).add_sink(wf.SinkBuilder(sink).build())
+    g.run()
+    w0 = OFF // SL  # tumbling-aligned epoch start
+    full = {w0 + j for j in range((N - WINL) // SL + 1)}
+    assert full <= set(got)
+    for w in full:
+        assert got[w] == float(WINL), (w, got[w])
+
+
+def test_wid_order_collector_watermark_semantics():
+    """The ordered collector is a per-(key, channel) watermark merge:
+    a slow channel HOLDS later windows (never emitted before an
+    earlier one), and anchored wid bases need no heuristics."""
+    from windflow_tpu.runtime.win_routing import WidOrderCollector
+
+    coll = WidOrderCollector()
+    coll.set_n_channels(3)
+    out = []
+
+    def wids():
+        return [r.get_control_fields()[1] for r in out]
+
+    # channels 1/2 race ahead while channel 0 (owner of wids 0,3,6) lags
+    for w, ch in [(1, 1), (2, 2), (4, 1), (5, 2), (7, 1), (8, 2)]:
+        coll.svc(BasicRecord(0, w, 0, float(w)), ch, out.append)
+    assert out == []  # silent channel holds the watermark
+    coll.svc(BasicRecord(0, 0, 0, 0.0), 0, out.append)
+    assert wids() == [0]
+    coll.svc(BasicRecord(0, 3, 0, 3.0), 0, out.append)
+    assert wids() == [0, 1, 2, 3]  # strictly ordered, nothing skipped
+    coll.eos_flush(out.append)
+    assert wids() == [0, 1, 2, 3, 4, 5, 7, 8]
+
+    # anchored base: wids start at an epoch-scale anchor, emission
+    # begins as soon as every channel has spoken -- no dense-from-0
+    # assumption, no adoption threshold
+    coll2 = WidOrderCollector()
+    coll2.set_n_channels(2)
+    out2 = []
+    A = 10**9
+    coll2.svc(BasicRecord(0, A, 0, 1.0), 0, out2.append)
+    assert out2 == []
+    coll2.svc(BasicRecord(0, A + 1, 0, 1.0), 1, out2.append)
+    assert [r.get_control_fields()[1] for r in out2] == [A]

@@ -157,6 +157,8 @@ class MultiPipe:
                 cnode = RtNode(f"{self.name}/{stage.name}.coll.g{g}", coll,
                                cch, [])
                 cnode.group = g
+                if hasattr(coll, "set_n_channels"):
+                    coll.set_n_channels(len(members))
                 for rn in members:
                     fwd = StandardEmitter()
                     fwd.set_n_destinations(1)
@@ -169,6 +171,8 @@ class MultiPipe:
             cch = make_channel(cfg)
             cnode = RtNode(f"{self.name}/{stage.name}.collector",
                            stage.collector, cch, [])
+            if hasattr(stage.collector, "set_n_channels"):
+                stage.collector.set_n_channels(len(replica_nodes))
             for rn in replica_nodes:
                 fwd = StandardEmitter()
                 fwd.set_n_destinations(1)

@@ -38,6 +38,20 @@ from ..base import Operator, StageSpec
 DEFAULT_BATCH_LEN = 256
 
 
+def _key_groups(keys: np.ndarray):
+    """Stable-group a key column: (order, keys_sorted, bounds) with
+    ``order`` None when the column is already sorted (saves the
+    re-index on the columnar hot path)."""
+    if len(keys) > 1 and not np.all(keys[:-1] <= keys[1:]):
+        order = np.argsort(keys, kind="stable")
+        keys_s = keys[order]
+    else:
+        order, keys_s = None, keys
+    edges = np.nonzero(np.diff(keys_s))[0] + 1
+    bounds = np.concatenate([[0], edges, [len(keys_s)]])
+    return order, keys_s, bounds
+
+
 class _AsyncDispatcher:
     """Dedicated launch thread: the ingest thread stages numpy buffers
     and hands them off; this thread pays the host->device transfer
@@ -237,14 +251,18 @@ class WinSeqTPULogic(NodeLogic):
         # feeding the p99 metric of BASELINE.md
         self.latency_samples: List[float] = []
         self._batch_birth: Optional[float] = None
-        # the C++ columnar engine covers the hot standalone case
-        # (native/window_engine.cpp): builtin sum, SEQ role, identity
-        # window assignment, no renumbering, default value column
+        # the C++ columnar engine covers the hot standalone cases
+        # (native/window_engine.cpp): builtin kinds, identity window
+        # assignment, default value column, role SEQ -- or role PLQ,
+        # whose only difference under an identity config is that output
+        # ids are per-key dense counters (plq_renumbered_id degenerates
+        # to the emit counter), applied on the flushed batch
         self._native = None
+        self._plq_counters: Dict[Any, int] = {}
         cfg = self.config
         if (isinstance(win_kind, str)
                 and win_kind in ("sum", "count", "max", "min", "mean")
-                and role == Role.SEQ
+                and role in (Role.SEQ, Role.PLQ)
                 and cfg.n_outer == 1 and cfg.n_inner == 1
                 and cfg.id_outer == 0 and cfg.id_inner == 0
                 and value_of is None):
@@ -364,10 +382,30 @@ class WinSeqTPULogic(NodeLogic):
             self._dispatcher = None
         self._flush_pending(emit, drain=True)
 
+    def _plq_renumber(self, d_keys: np.ndarray) -> np.ndarray:
+        """Dense per-key output ids for the native PLQ lane: windows of
+        a key arrive in firing order, so each gets the key's running
+        emit counter (win_seq.hpp:484 with an identity config)."""
+        out = np.empty(len(d_keys), np.int64)
+        order, keys_s, bounds = _key_groups(d_keys)
+        for j in range(len(bounds) - 1):
+            lo, hi = int(bounds[j]), int(bounds[j + 1])
+            key = int(keys_s[lo])
+            start = self._plq_counters.get(key, 0)
+            ids = np.arange(start, start + (hi - lo))
+            if order is None:
+                out[lo:hi] = ids
+            else:
+                out[order[lo:hi]] = ids
+            self._plq_counters[key] = start + (hi - lo)
+        return out
+
     def _emit_results(self, results, descs, emit) -> None:
         if isinstance(descs, tuple) and descs[0] == "native":
             # native-engine batch: columnar descriptor arrays
             _, d_keys, d_gwids, d_rts = descs
+            if self.role == Role.PLQ:
+                d_gwids = self._plq_renumber(d_keys)
             if self.emit_batches:
                 emit(TupleBatch({"key": d_keys, "id": d_gwids,
                                  "ts": d_rts,
@@ -605,16 +643,11 @@ class WinSeqTPULogic(NodeLogic):
         ids = batch.id if self.win_type == WinType.CB else batch.ts
         vals = batch["value"]
         tss = batch.ts
-        if len(keys) > 1 and np.all(keys[:-1] <= keys[1:]):
-            keys_s, ids_s, vals_s, tss_s = keys, ids, vals, tss
+        order, keys_s, bounds = _key_groups(keys)
+        if order is None:
+            ids_s, vals_s, tss_s = ids, vals, tss
         else:
-            order = np.argsort(keys, kind="stable")
-            keys_s, ids_s = keys[order], ids[order]
-            vals_s, tss_s = vals[order], tss[order]
-        # group boundaries on the sorted key column (cheaper than
-        # np.unique: one diff over the sorted array)
-        edges = np.nonzero(np.diff(keys_s))[0] + 1
-        bounds = np.concatenate([[0], edges, [len(keys_s)]])
+            ids_s, vals_s, tss_s = ids[order], vals[order], tss[order]
         uniq = keys_s[bounds[:-1]]
         cfg = self.config
         for j, key in enumerate(uniq):
@@ -778,6 +811,7 @@ class WinSeqTPULogic(NodeLogic):
         }
         if self._native is not None:
             st["native"] = self._native.serialize()
+            st["plq_counters"] = dict(self._plq_counters)
         else:
             # deep copy: a live checkpoint resumes the stream after the
             # snapshot, and an aliased store would keep advancing
@@ -795,6 +829,7 @@ class WinSeqTPULogic(NodeLogic):
                     "snapshot came from the native engine but this "
                     "replica runs the Python path")
             self._native.deserialize(state["native"])
+            self._plq_counters = dict(state.get("plq_counters", {}))
         else:
             if self._native is not None:
                 raise RuntimeError(

@@ -201,33 +201,58 @@ class WinMapEmitter(Emitter):
 
 
 class WidOrderCollector(NodeLogic):
-    """Reorders window results of each key by (dense) window id before
+    """Reorders window results of each key by window id before
     forwarding -- the WF/KF ordered-collector and the WinMap collector
-    (wf_nodes.hpp:253-316, kf_nodes.hpp:116-180, wm_nodes.hpp:259-326)."""
+    (wf_nodes.hpp:253-316, kf_nodes.hpp:116-180, wm_nodes.hpp:259-326).
 
-    def __init__(self):
-        self.next_win: Dict[Any, int] = {}
+    Ordering is a per-(key, channel) watermark-by-min merge: each
+    producer emits its own windows of a key in wid order, so a result
+    is safe to forward once every producer channel has delivered a wid
+    at or beyond it.  Unlike a dense from-0 counter, this is correct
+    for ANCHORED streams (window ids starting at an epoch-scale anchor)
+    and needs no heuristics; a key whose window count is below the
+    producer count keeps its (few) results buffered until EOS."""
+
+    def __init__(self, n_channels: int = 1):
+        self.n_channels = n_channels
+        self.maxs: Dict[Any, List[int]] = {}   # key -> per-channel max wid
         self.pending: Dict[Any, List] = {}
+
+    def set_n_channels(self, n: int) -> None:
+        """Called at graph wiring with the upstream producer count."""
+        self.n_channels = max(1, n)
 
     def svc(self, item, channel_id, emit):
         if isinstance(item, EOSMarker):
             return
         rec = item
         key, wid, _ = rec.get_control_fields()
-        nxt = self.next_win.get(key, 0)
+        maxs = self.maxs.get(key)
+        if maxs is None:
+            maxs = self.maxs[key] = [-1] * self.n_channels
+        if wid > maxs[channel_id]:
+            maxs[channel_id] = wid
         heap = self.pending.setdefault(key, [])
         heapq.heappush(heap, (wid, id(rec), rec))
-        while heap and heap[0][0] <= nxt:
-            w, _, r = heapq.heappop(heap)
-            if w == nxt:
-                emit(r)
-                nxt += 1
-            else:  # duplicate/old wid: forward anyway to avoid loss
-                emit(r)
-        self.next_win[key] = nxt
+        watermark = min(maxs)
+        while heap and heap[0][0] <= watermark:
+            _, _, r = heapq.heappop(heap)
+            emit(r)
 
     def eos_flush(self, emit):
         for key, heap in self.pending.items():
             while heap:
                 _, _, r = heapq.heappop(heap)
                 emit(r)
+
+    # live-checkpoint snapshots (deep copies: the resumed run keeps
+    # popping the live heaps)
+    def state_dict(self):
+        import copy
+        return {"maxs": {k: list(v) for k, v in self.maxs.items()},
+                "pending": copy.deepcopy(self.pending)}
+
+    def load_state(self, state):
+        import copy
+        self.maxs = {k: list(v) for k, v in state["maxs"].items()}
+        self.pending = copy.deepcopy(state["pending"])
