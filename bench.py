@@ -90,27 +90,28 @@ INFLIGHT = 8
 BASELINE_EVENTS = 32_000_000
 
 
-def _template_source(n_events, state):
+def _template_source(n_events, state, source_batch=None):
     """Columnar synthetic source shared by the device configs: key
     round-robin, per-key dense ids, f32 value pool (the metric is
     window-aggregation throughput, not host RNG throughput)."""
     from windflow_tpu.core.tuples import TupleBatch
-    arange = np.arange(SOURCE_BATCH, dtype=np.int64)
+    sb = source_batch or SOURCE_BATCH
+    arange = np.arange(sb, dtype=np.int64)
     keys_t = arange % N_KEYS
     ids_t = arange // N_KEYS
-    assert SOURCE_BATCH % N_KEYS == 0
+    assert sb % N_KEYS == 0
 
     def source(ctx):
         ridx = ctx.get_replica_index()
         st = state.setdefault(ridx, {
             "sent": 0,
             "pool": np.random.default_rng(ridx).random(
-                SOURCE_BATCH).astype(np.float32)})
+                sb).astype(np.float32)})
         i = st["sent"]
         share = n_events // SOURCE_PARALLELISM
         if i >= share:
             return None
-        n = min(SOURCE_BATCH, share - i)
+        n = min(sb, share - i)
         ids = ids_t[:n] + (i // N_KEYS)
         batch = TupleBatch({
             "key": keys_t[:n],
@@ -151,9 +152,12 @@ def _collect_latency(g):
     return lat
 
 
-def run_win_seq_tpu(n_events):
+def run_win_seq_tpu(n_events, source_batch=None, delay_ms=10.0):
     """Config #2: BatchSource -> WinSeqTPU (device-batched sums, async
-    double-buffered, time-bounded launches) -> counting sink."""
+    double-buffered, time-bounded launches) -> counting sink.  The
+    latency-tuned variant shrinks the source batch and the launch
+    rate-limit, trading ~15% throughput for a p99 near the transport
+    round-trip floor."""
     import windflow_tpu as wf
     from windflow_tpu.operators.batch_ops import BatchSource
     from windflow_tpu.operators.basic_ops import Sink
@@ -163,9 +167,11 @@ def run_win_seq_tpu(n_events):
     g = wf.PipeGraph("bench2", wf.Mode.DEFAULT)
     op = WinSeqTPU("sum", WIN, SLIDE, wf.WinType.TB,
                    batch_len=DEVICE_BATCH, emit_batches=True,
-                   max_buffer_elems=MAX_BUFFER, inflight_depth=INFLIGHT)
-    g.add_source(BatchSource(_template_source(n_events, {}),
-                             SOURCE_PARALLELISM)) \
+                   max_buffer_elems=MAX_BUFFER, inflight_depth=INFLIGHT,
+                   max_batch_delay_ms=delay_ms)
+    g.add_source(BatchSource(
+        _template_source(n_events, {}, source_batch),
+        SOURCE_PARALLELISM)) \
         .add(op).add_sink(Sink(sink))
     t0 = time.perf_counter()
     g.run()
@@ -333,6 +339,15 @@ def main():
         "p99_batch_latency_ms": (round(float(p99), 2)
                                  if np.isfinite(p99) else None),
         "vs_baseline": _vs(rate2)}
+    # latency-tuned operating point of the same pipeline
+    rate2b, w2b, _dt, lat_b = run_win_seq_tpu(
+        16_000_000, source_batch=SOURCE_BATCH // 4, delay_ms=3.0)
+    p99b = np.percentile(lat_b, 99) * 1e3 if lat_b else float("nan")
+    configs["2b_win_seq_tpu_low_latency"] = {
+        "rate": round(rate2b, 1), "windows": w2b,
+        "p99_batch_latency_ms": (round(float(p99b), 2)
+                                 if np.isfinite(p99b) else None),
+        "vs_baseline": _vs(rate2b)}
     rate3, w3 = run_pane_farm_tpu(16_000_000)
     configs["3_pane_farm_tpu"] = {"rate": round(rate3, 1), "windows": w3}
     rate4, w4 = run_key_farm_tpu(16_000_000)

@@ -53,19 +53,31 @@ def build_pipeline(graph, n_events: int, n_ads: int = 1000,
     from ..operators.tpu.farms_tpu import KeyFarmTPU
 
     campaign_of_ad = make_campaign_map(n_ads, n_campaigns)
-    state = {"sent": 0}
+    # pre-generated event pool, re-timestamped per batch: the metric is
+    # pipeline throughput, not host RNG throughput (mp_tests sources
+    # pre-fill their input vectors the same way)
+    pool = synth_events(batch_size, n_ads, seed=0)
+    ones = np.ones(batch_size, np.float64)
+    state = {}  # per-replica batch cursors (replicas share this closure)
 
     def source(ctx):
-        i = state["sent"]
-        if i >= n_events:
+        # replica r emits every par-th BATCH of the global timeline:
+        # timestamps stay globally increasing with disorder bounded by
+        # ~par batches (DETERMINISTIC mode makes multi-replica runs
+        # exact; disjoint per-replica ts ranges would instead interleave
+        # epoch-apart timestamps into the TB windows)
+        ridx = ctx.get_replica_index()
+        st = state.setdefault(ridx, {"b": ridx})
+        base = st["b"] * batch_size
+        if base >= n_events:
             return None
-        n = min(batch_size, n_events - i)
-        ev = synth_events(n, n_ads, seed=i, ts_start=i)
-        state["sent"] = i + n
+        n = min(batch_size, n_events - base)
+        ts = base + pool["ts"][:n]
+        st["b"] += max(1, source_parallelism)
         return TupleBatch({
-            "key": ev["ad_id"], "id": ev["ts"], "ts": ev["ts"],
-            "value": np.ones(n, np.float64),
-            "event_type": ev["event_type"],
+            "key": pool["ad_id"][:n], "id": ts, "ts": ts,
+            "value": ones[:n],
+            "event_type": pool["event_type"][:n],
         })
 
     def views_only(batch):
