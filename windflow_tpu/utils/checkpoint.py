@@ -8,10 +8,13 @@ snapshots of per-key window state); this module walks a PipeGraph and
 saves/restores every replica's state.
 
 Scope and contract:
-* checkpoint between items -- the runtime only calls these while a
-  node is quiescent (before start or after wait_end; a live barrier
-  protocol is future work);
-* user record/result types must be picklable.
+* checkpoint at quiescent points: before start, after wait_end, or
+  mid-stream through the LIVE barrier (``PipeGraph.quiesce()`` /
+  ``live_checkpoint()`` pause sources, drain channels and in-flight
+  device batches, snapshot, resume);
+* user record/result types must be picklable;
+* restores pair with source replay from the captured offset
+  (at-least-once without source acknowledgement).
 """
 from __future__ import annotations
 
