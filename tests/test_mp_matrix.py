@@ -174,17 +174,25 @@ def test_matrix_randomized_parallelism(kind, win_type):
         expected_total(per_key, N_KEYS, WIN, SLIDE)
 
 
-@pytest.mark.parametrize("kind", ["kf", "kff"])
+@pytest.mark.parametrize("kind", ["kf", "kff", "wf", "pf", "wmr"])
 def test_string_keys(kind):
-    """_string variants: non-integral keys through hash routing."""
+    """_string variants: non-integral keys through hash routing, for
+    every window operator family (the reference's *_string tests).  CB
+    kinds renumber arrival-dense ids in DEFAULT mode; the multicast
+    kinds run TB windows over the stream's own timestamps."""
     sink = SumSink()
     g = wf.PipeGraph("mp", Mode.DEFAULT)
+    cb = kind in ("kf", "kff")
     src = pareto_ooo_stream(N_KEYS, PER_KEY, jitter=1, key_type="str")
-    op = build_window_op(kind, WinType.CB, 3, random.Random(1))
+    op = build_window_op(kind, WinType.CB if cb else WinType.TB, 3,
+                         random.Random(1))
     g.add_source(wf.SourceBuilder(src).build()) \
         .add(op).add_sink(wf.SinkBuilder(sink).build())
     g.run()
-    assert sink.total == expected_total(PER_KEY, N_KEYS, WIN, SLIDE)
+    if cb:
+        assert sink.total == expected_total(PER_KEY, N_KEYS, WIN, SLIDE)
+    else:
+        assert sink.total == expected_sum_of_events(src.events, WIN, SLIDE)
 
 
 def test_probabilistic_mode_out_of_order():
