@@ -14,6 +14,7 @@
 // blocked on a full channel never stalls consumers.
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -54,21 +55,43 @@ struct Channel {
         not_empty.notify_one();
     }
 
+    // One popped item through the EOS protocol (lock held, q nonempty):
+    // 1 = delivered, 0 = all producers closed, -1 = swallowed EOS.
+    int pop_locked(std::uintptr_t* handle, int* cid) {
+        Item it = q.front();
+        q.pop_front();
+        not_full.notify_one();
+        if (it.eos) {
+            if (++eos_seen >= n_producers) return 0;
+            return -1;
+        }
+        *handle = it.handle;
+        *cid = it.producer;
+        return 1;
+    }
+
     // Returns 1 with *handle/*cid set; 0 once every producer closed.
     int get(std::uintptr_t* handle, int* cid) {
         std::unique_lock<std::mutex> lk(mu);
         for (;;) {
             not_empty.wait(lk, [&] { return !q.empty(); });
-            Item it = q.front();
-            q.pop_front();
-            not_full.notify_one();
-            if (it.eos) {
-                if (++eos_seen >= n_producers) return 0;
-                continue;
-            }
-            *handle = it.handle;
-            *cid = it.producer;
-            return 1;
+            int rc = pop_locked(handle, cid);
+            if (rc >= 0) return rc;
+        }
+    }
+
+    // Timed variant for idle-tick consumers: additionally returns 2
+    // when the timeout elapses with nothing to deliver.
+    int get_timed(std::uintptr_t* handle, int* cid, long long timeout_ms) {
+        std::unique_lock<std::mutex> lk(mu);
+        auto deadline = std::chrono::steady_clock::now()
+            + std::chrono::milliseconds(timeout_ms);
+        for (;;) {
+            if (!not_empty.wait_until(lk, deadline,
+                                      [&] { return !q.empty(); }))
+                return 2;
+            int rc = pop_locked(handle, cid);
+            if (rc >= 0) return rc;
         }
     }
 
@@ -102,6 +125,11 @@ void wfn_channel_close(void* ch, int producer) {
 
 int wfn_channel_get(void* ch, std::uintptr_t* handle, int* cid) {
     return static_cast<Channel*>(ch)->get(handle, cid);
+}
+
+int wfn_channel_get_timed(void* ch, std::uintptr_t* handle, int* cid,
+                          long long timeout_ms) {
+    return static_cast<Channel*>(ch)->get_timed(handle, cid, timeout_ms);
 }
 
 std::size_t wfn_channel_size(void* ch) {

@@ -666,3 +666,68 @@ class TestResidentFFAT:
             by_key.setdefault(r.key, []).append(r.value)
         # new keys' windows must hold only their own values (8 x 2.0)
         assert by_key[4] == [16.0] and by_key[5] == [16.0]
+
+
+def test_idle_tick_launches_on_stalled_stream():
+    """A source that stalls mid-stream must not withhold fired windows:
+    the node's timed gets drive WinSeqTPULogic.idle_tick, which
+    launches staged/ready windows once the rate-limit allows."""
+    import threading
+    import time
+    import numpy as np
+    import windflow_tpu as wf
+    from windflow_tpu.core import Mode, WinType
+    from windflow_tpu.core.tuples import TupleBatch
+    from windflow_tpu.operators.basic_ops import Sink
+    from windflow_tpu.operators.batch_ops import BatchSource
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
+
+    gate = threading.Event()
+    state = {"phase": 0}
+
+    def batch(lo):
+        idx = lo + np.arange(4096)
+        return TupleBatch({"key": idx % 2, "id": idx // 2,
+                           "ts": idx // 2, "value": np.ones(4096)})
+
+    def source(ctx):
+        ph = state["phase"]
+        state["phase"] = ph + 1
+        if ph == 0:
+            # fires 14 windows/key; launches at svc (rate limit idle)
+            # and stamps _last_launch_t
+            return batch(0)
+        if ph == 1:
+            # fires 16 more windows/key, arriving within the rate
+            # limit: they stage but can NOT launch at svc -- only an
+            # idle tick can deliver them during the stall
+            return batch(4096)
+        gate.wait(30)
+        return None
+
+    count = {"n": 0}
+    lock = threading.Lock()
+
+    def sink(item):
+        if item is None:
+            return
+        with lock:
+            count["n"] += 1
+
+    g = wf.PipeGraph("stall", Mode.DEFAULT)
+    # batch_len high so the size trigger can NOT fire; only the time
+    # trigger (via idle ticks) can launch during the stall
+    op = WinSeqTPU("sum", 256, 128, WinType.TB, batch_len=1 << 16,
+                   max_batch_delay_ms=20.0)
+    g.add_source(BatchSource(source, 1)).add(op).add_sink(Sink(sink))
+    g.start()
+    # all 60 fired windows (30/key up to id 4095) must arrive DURING
+    # the stall, before the source is released
+    deadline = time.monotonic() + 20
+    while count["n"] < 60 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    stalled_count = count["n"]
+    gate.set()
+    g.wait_end()
+    assert stalled_count >= 60, \
+        f"only {stalled_count} windows emitted during the stall"

@@ -189,9 +189,11 @@ class PipeGraph:
             self._monitor = MonitoringThread(self)
             self._monitor.start()
         # wire the live-checkpoint pause gate into every source replica
+        # and every node (consumer idle ticks pause with the barrier)
         from ..runtime.node import SourceLoopLogic, SourcePauseControl
         self._pause_ctl = SourcePauseControl()
         for n in self._all_nodes():
+            n.pause_ctl = self._pause_ctl
             if n.channel is None and isinstance(n.logic, SourceLoopLogic):
                 n.logic.pause_control = self._pause_ctl
         for n in self._all_nodes():

@@ -786,6 +786,23 @@ class WinSeqTPULogic(NodeLogic):
         self._launch(emit)
         self._drain_all(emit)
 
+    def idle_tick(self, emit) -> None:
+        """Stalled-stream launch trigger (RtNode timed gets): windows
+        that fired but sit staged/ready while no input arrives must
+        still launch once the rate-limit allows -- otherwise a paused
+        source withholds results until the next batch or EOS."""
+        if self.pending:
+            # inline-dispatch mode parks computed batches in `pending`
+            # until the next launch; a stall must drain the ready ones
+            self._flush_pending(emit)
+        if not self._launch_due():
+            return
+        if self._native is not None:
+            if self._native.ready():
+                self._native_launch(emit)
+        elif self.descriptors:
+            self._launch(emit)
+
     def quiesce(self, emit) -> bool:
         """Live-checkpoint barrier hook (pipegraph.quiesce): drain every
         in-flight device batch, emitting its results, so ``state_dict``

@@ -18,6 +18,8 @@ import subprocess
 import threading
 from typing import Any, Optional, Tuple
 
+from .queues import CHANNEL_TIMEOUT
+
 _lib = None
 _lib_lock = threading.Lock()
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
@@ -72,6 +74,10 @@ def get_lib():
         lib.wfn_channel_put.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                         ctypes.c_size_t]
         lib.wfn_channel_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.wfn_channel_get_timed.restype = ctypes.c_int
+        lib.wfn_channel_get_timed.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_int), ctypes.c_longlong]
         lib.wfn_channel_get.restype = ctypes.c_int
         lib.wfn_channel_get.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_size_t),
@@ -179,12 +185,19 @@ class NativeChannel:
     def close(self, producer_id: int) -> None:
         self.lib.wfn_channel_close(self.ptr, producer_id)
 
-    def get(self) -> Optional[Tuple[int, Any]]:
+    def get(self, timeout: Optional[float] = None):
         handle = ctypes.c_size_t()
         cid = ctypes.c_int()
-        ok = self.lib.wfn_channel_get(self.ptr, ctypes.byref(handle),
-                                      ctypes.byref(cid))
-        if not ok:
+        if timeout is None:
+            rc = self.lib.wfn_channel_get(self.ptr, ctypes.byref(handle),
+                                          ctypes.byref(cid))
+        else:
+            rc = self.lib.wfn_channel_get_timed(
+                self.ptr, ctypes.byref(handle), ctypes.byref(cid),
+                max(1, int(timeout * 1000)))
+        if rc == 2:
+            return CHANNEL_TIMEOUT
+        if not rc:
             return None
         obj = ctypes.cast(handle.value, ctypes.py_object).value
         ctypes.pythonapi.Py_DecRef(ctypes.py_object(obj))

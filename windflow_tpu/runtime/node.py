@@ -14,7 +14,7 @@ import threading
 import traceback
 from typing import Any, Callable, List, Optional, Sequence
 
-from .queues import Channel
+from .queues import Channel, CHANNEL_TIMEOUT
 
 
 class EOSMarker:
@@ -64,6 +64,19 @@ class ChainedLogic(NodeLogic):
     def __init__(self, a: NodeLogic, b: NodeLogic):
         self.a = a
         self.b = b
+        # delegate idle ticks only when a half defines them: RtNode
+        # probes hasattr, and unconditional definition would put every
+        # fused map chain on timed gets for nothing
+        if hasattr(a, "idle_tick") or hasattr(b, "idle_tick"):
+            self.idle_tick = self._idle_tick
+
+    def _idle_tick(self, emit):
+        ta = getattr(self.a, "idle_tick", None)
+        if ta is not None:
+            ta(lambda x: self.b.svc(x, 0, emit))
+        tb = getattr(self.b, "idle_tick", None)
+        if tb is not None:
+            tb(emit)
 
     def svc_init(self):
         # the RtNode attaches the replica StatsRecord to the OUTER
@@ -188,6 +201,11 @@ class RtNode(threading.Thread):
         # in flight while taken != done
         self.taken = 0
         self.done = 0
+        # the graph's SourcePauseControl (attached at start): idle
+        # ticks must not fire while a live-checkpoint barrier is
+        # pausing -- any launch they start strictly precedes a barrier
+        # drain pass only if no NEW ticks begin after the pause request
+        self.pause_ctl = None
 
     def _emit(self, item: Any) -> None:
         if self.stats is not None:
@@ -203,8 +221,18 @@ class RtNode(threading.Thread):
             self.logic.svc_init()
             if self.channel is not None:
                 stats = self.stats
+                # logics with an idle_tick hook (time-bounded device
+                # launches on stalled streams) take timed gets so the
+                # tick fires without input
+                tick = getattr(self.logic, "idle_tick", None)
                 while True:
-                    got = self.channel.get()
+                    got = (self.channel.get(timeout=0.025) if tick
+                           else self.channel.get())
+                    if got is CHANNEL_TIMEOUT:
+                        if not (self.pause_ctl is not None
+                                and self.pause_ctl.pausing):
+                            tick(self._emit)
+                        continue
                     if got is None:
                         break
                     cid, item = got

@@ -18,6 +18,10 @@ from ..core.basic import DEFAULT_QUEUE_CAPACITY
 
 _EOS_SENTINEL = object()
 
+# returned by get(timeout=...) when the wait elapses: distinct from
+# None (which means every producer closed)
+CHANNEL_TIMEOUT = object()
+
 
 class Channel:
     """Bounded multi-producer single-consumer channel.
@@ -58,10 +62,16 @@ class Channel:
     def close(self, producer_id: int) -> None:
         self.q.put((producer_id, _EOS_SENTINEL))
 
-    def get(self) -> Optional[Tuple[int, Any]]:
-        """Next (channel_id, item); None when all producers closed."""
+    def get(self, timeout: Optional[float] = None):
+        """Next (channel_id, item); None when all producers closed;
+        CHANNEL_TIMEOUT when ``timeout`` seconds pass with nothing to
+        deliver (idle-tick consumers)."""
         while True:
-            pid, item = self.q.get()
+            try:
+                pid, item = (self.q.get(timeout=timeout)
+                             if timeout is not None else self.q.get())
+            except _queue.Empty:
+                return CHANNEL_TIMEOUT
             if item is _EOS_SENTINEL:
                 self._eos_seen += 1
                 if self._eos_seen >= self.n_producers:
