@@ -246,3 +246,42 @@ def test_lowering_refuses_opaque_callables():
     g.run()
     assert not getattr(g, "_lowered", False)
     assert tot["n"] > 0
+
+
+def test_mean_identical_on_all_three_planes():
+    """A 'mean' pipeline produces identical results on the Python
+    scalar plane, the natively-lowered record plane, and the columnar
+    XLA plane (the builtin sets agree everywhere)."""
+    n, K, win, slide = 30_000, 4, 64, 32
+    results = {}
+    for plane in ("python", "native", "columnar"):
+        got = {}
+        lock = threading.Lock()
+
+        def sink(rec):
+            if rec is None:
+                return
+            with lock:
+                got[(rec.key, rec.id)] = rec.value
+
+        cfg = RuntimeConfig(native_record_lowering=(plane == "native"))
+        g = wf.PipeGraph("m", wf.Mode.DEFAULT, cfg)
+        pipe = g.add_source(SyntheticSource(n, K, emit_batches=False,
+                                            batch=4096))
+        if plane == "columnar":
+            from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
+            op = WinSeqTPU("mean", win, slide, WinType.TB, batch_len=256)
+        else:
+            op = wf.KeyFarmBuilder("mean").with_parallelism(2) \
+                .with_tb_windows(win, slide).build()
+        pipe.add(op).add_sink(wf.SinkBuilder(sink).build())
+        g.run()
+        if plane == "native":
+            assert getattr(g, "_lowered", False)
+        results[plane] = got
+    assert results["python"].keys() == results["native"].keys() \
+        == results["columnar"].keys()
+    for k in results["python"]:
+        a, b, c = (results[p][k] for p in ("python", "native", "columnar"))
+        assert abs(a - b) < 1e-9, (k, a, b)
+        assert abs(a - c) < 1e-4 * max(1, abs(a)), (k, a, c)
