@@ -458,3 +458,39 @@ def test_columnar_plane_ordering_modes(mode):
     expect_total = (sum(wins.values())
                     - sum(wins[(k, gw)] for k, gw, _ in dropped_res))
     assert sum(got.values()) == expect_total
+
+
+def test_mixed_plane_collector_rejected():
+    """A collector serving both records and TupleBatches would hold two
+    independent orderings; the mix is rejected loudly."""
+    import numpy as np
+    from windflow_tpu.core.basic import OrderingMode
+    from windflow_tpu.core.tuples import TupleBatch
+    from windflow_tpu.runtime.ordering import KSlackLogic, OrderingLogic
+
+    for logic in (OrderingLogic(OrderingMode.TS, 1), KSlackLogic()):
+        logic.svc(BasicRecord(0, 0, 0, 1.0), 0, lambda x: None)
+        with pytest.raises(RuntimeError, match="mixed"):
+            logic.svc(TupleBatch({"key": np.zeros(1, np.int64),
+                                  "id": np.zeros(1, np.int64),
+                                  "ts": np.zeros(1, np.int64),
+                                  "value": np.ones(1)}), 0,
+                      lambda x: None)
+
+
+def test_eos_markers_are_plane_neutral():
+    """Batch streams carry per-key RECORD EOS markers (WFEmitter); the
+    mixed-plane guard must not reject them."""
+    import numpy as np
+    from windflow_tpu.core.basic import OrderingMode
+    from windflow_tpu.core.tuples import TupleBatch
+    from windflow_tpu.runtime.node import EOSMarker
+    from windflow_tpu.runtime.ordering import KSlackLogic, OrderingLogic
+
+    for logic in (OrderingLogic(OrderingMode.TS, 1), KSlackLogic()):
+        logic.svc(TupleBatch({"key": np.zeros(1, np.int64),
+                              "id": np.zeros(1, np.int64),
+                              "ts": np.zeros(1, np.int64),
+                              "value": np.ones(1)}), 0, lambda x: None)
+        logic.svc(EOSMarker(BasicRecord(0, 5, 5, 0.0)), 0,
+                  lambda x: None)  # must not raise

@@ -98,15 +98,15 @@ def _batched_programs(combine: Callable, neutral: float, n: int):
     levels = int(np.log2(n))
     assert 1 << levels == n, "FlatFAT capacity must be a power of two"
 
-    # donate the resident tree: the forest is HBM-resident across the
-    # stream's lifetime and every update returns its successor -- without
-    # donation XLA holds two full [K, 2n] copies per update.  CPU (the
-    # test backend) does not implement donation and would warn per call;
-    # WINDFLOW_DONATE_FOREST=0 opts out on transports where donation
-    # misbehaves.
+    # WINDFLOW_DONATE_FOREST=1 donates the resident tree: the forest is
+    # HBM-resident across the stream's lifetime and every update
+    # returns its successor, so donation halves the forest's HBM
+    # footprint.  Opt-in for now: CPU (the test backend) does not
+    # implement donation, and the relayed-TPU transport has not yet
+    # been exercised with donated buffers.
     import os
     donate = ((0,) if jax.default_backend() != "cpu"
-              and os.environ.get("WINDFLOW_DONATE_FOREST", "1") != "0"
+              and os.environ.get("WINDFLOW_DONATE_FOREST") == "1"
               else ())
 
     @functools.partial(jax.jit, donate_argnums=donate)

@@ -83,6 +83,20 @@ class _ColumnarMerge:
         return min(self.maxs)
 
 
+def _check_plane(logic, plane: str) -> None:
+    """The record queues and the columnar buffers are independent
+    orderings; interleaving them would silently break the global order,
+    so a collector serves exactly one plane per stream."""
+    cur = getattr(logic, "_plane", None)
+    if cur is None:
+        logic._plane = plane
+    elif cur != plane:
+        raise RuntimeError(
+            "mixed record/batch streams through one ordering collector "
+            "are unsupported; materialize one plane before the "
+            "DETERMINISTIC/PROBABILISTIC stage")
+
+
 def _renumber_columnar(batch: TupleBatch, get_counter, bump_counter):
     """Per-key dense ids in emitted order (columnar twin of the
     TS_RENUMBERING record path, shared by both collectors)."""
@@ -141,9 +155,10 @@ class OrderingLogic(NodeLogic):
             # ID ordering is per-key dense-id arithmetic; the columnar
             # lane is timestamp-based, so degrade this batch to the
             # record plane (slow but correct -- CB batch streams in
-            # DETERMINISTIC mode are an edge, not the hot path)
+            # DETERMINISTIC mode are an edge, not the hot path; bypasses
+            # the plane guard, which tracks the USER-facing item type)
             for rec in batch.records():
-                self.svc(rec, channel_id, emit)
+                self._svc_record(rec, channel_id, emit)
             return
         if self._cmerge is None:
             self._cmerge = _ColumnarMerge("ts", self.n_channels)
@@ -195,8 +210,16 @@ class OrderingLogic(NodeLogic):
 
     def svc(self, item, channel_id, emit):
         if isinstance(item, TupleBatch):
+            _check_plane(self, "batch")
             self._svc_batch(item, channel_id, emit)
             return
+        if not isinstance(item, EOSMarker):
+            # EOS markers are plane-neutral: batch streams still carry
+            # per-key record markers (WFEmitter._emit_batch)
+            _check_plane(self, "record")
+        self._svc_record(item, channel_id, emit)
+
+    def _svc_record(self, item, channel_id, emit):
         rec = item.record if isinstance(item, EOSMarker) else item
         key = rec.get_control_fields()[0]
         wid = self._order_field(rec)
@@ -371,12 +394,14 @@ class KSlackLogic(NodeLogic):
 
     def svc(self, item, channel_id, emit):
         if isinstance(item, TupleBatch):
+            _check_plane(self, "batch")
             self._svc_batch(item, emit)
             return
-        rec = item.record if isinstance(item, EOSMarker) else item
-        ts = rec.get_control_fields()[2]
         if isinstance(item, EOSMarker):
-            return  # markers carry no data; flush happens at EOS
+            return  # plane-neutral; flush happens at EOS
+        _check_plane(self, "record")
+        rec = item
+        ts = rec.get_control_fields()[2]
         self.ts_sample.append(ts)
         i = bisect.bisect_left(self.buffer_ts, ts)
         self.buffer_ts.insert(i, ts)
