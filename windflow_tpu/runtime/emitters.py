@@ -54,14 +54,33 @@ class StandardEmitter(Emitter):
                 # vectorized KEYBY: partition the batch by key hash
                 import numpy as np
                 dests = np.abs(item.key) % self.n_dest
-                for d in np.unique(dests):
-                    send_to(int(d), item.take(dests == d))
+                for d, sub in partition_batch(item, dests):
+                    send_to(d, sub)
         elif self.keyed:
             rec = item.record if isinstance(item, EOSMarker) else item
             send_to(default_hash(self.key_of(rec)) % self.n_dest, item)
         else:
             send_to(self._rr, item)
             self._rr = (self._rr + 1) % self.n_dest
+
+
+def partition_batch(batch, dests):
+    """Destination partition of a TupleBatch (shared by the KEYBY
+    emitters).  A batch whose rows all route to one destination ships
+    as-is (zero copies -- the common case for few-key streams); the
+    multi-destination path uses one boolean-mask gather per
+    destination, which measures faster than a sort-based single pass
+    (the argsort dominates).  Mask selection preserves arrival order
+    within each destination.  Yields (dest, sub_batch)."""
+    import numpy as np
+    if len(dests) == 0:
+        return
+    lo_d, hi_d = int(dests.min()), int(dests.max())
+    if lo_d == hi_d:  # single destination: ship the batch as-is
+        yield lo_d, batch
+        return
+    for d in np.unique(dests):
+        yield int(d), batch.take(dests == d)
 
 
 class BroadcastEmitter(Emitter):

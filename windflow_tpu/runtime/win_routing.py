@@ -14,7 +14,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..core.basic import Role, WinType
 from ..core.meta import default_hash
 from ..core.win_assign import wf_destinations, window_range_of
-from .emitters import Emitter
+from .emitters import Emitter, partition_batch
 from .node import EOSMarker, NodeLogic
 
 
@@ -154,15 +154,25 @@ class KFEmitter(Emitter):
     def __init__(self, pardegree: int,
                  routing: Callable[[int, int], int] = None):
         self.pardegree = pardegree
+        self._default_routing = routing is None
         self.routing = routing or (lambda h, n: h % n)
 
     def emit(self, item, send_to):
         from ..core.tuples import TupleBatch
         if isinstance(item, TupleBatch):
             import numpy as np
-            dests = np.abs(item.key) % self.pardegree
-            for d in np.unique(dests):
-                send_to(int(d), item.take(dests == d))
+            if self._default_routing:
+                dests = np.abs(item.key) % self.pardegree
+            else:
+                # custom routing fn: the record path and the batch path
+                # MUST agree per key or a key's substream splits across
+                # workers (int64 batch keys hash to themselves)
+                dests = np.fromiter(
+                    (self.routing(int(k) if k >= 0 else -int(k),
+                                  self.pardegree) for k in item.key),
+                    np.int64, len(item.key))
+            for d, sub in partition_batch(item, dests):
+                send_to(d, sub)
             return
         rec = item.record if isinstance(item, EOSMarker) else item
         key = rec.get_control_fields()[0]
