@@ -731,3 +731,19 @@ def test_idle_tick_launches_on_stalled_stream():
     g.wait_end()
     assert stalled_count >= 60, \
         f"only {stalled_count} windows emitted during the stall"
+
+
+def test_pallas_winsum_engine_path(monkeypatch):
+    """WINDFLOW_PALLAS_WINSUM=1 routes builtin sum batches through the
+    hand-scheduled Pallas kernel (interpret mode off TPU) with results
+    identical to the XLA paths."""
+    monkeypatch.setenv("WINDFLOW_PALLAS_WINSUM", "1")
+    eng = WindowComputeEngine("sum")
+    rng = np.random.default_rng(2)
+    vals = rng.random(5000).astype(np.float64)
+    starts = np.sort(rng.integers(0, 4000, 16)).astype(np.int64)
+    ends = starts + rng.integers(1, 900, 16)
+    out = eng.compute({"value": vals}, starts, ends,
+                      np.arange(16)).block()
+    expect = [vals[s:e].sum() for s, e in zip(starts, ends)]
+    np.testing.assert_allclose(out, expect, rtol=1e-3)

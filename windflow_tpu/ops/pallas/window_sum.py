@@ -75,17 +75,28 @@ def window_sums(values: np.ndarray, starts: np.ndarray,
 
     values is padded to a multiple of 128 lanes; starts/ends are int32.
     """
-    import jax
     import jax.numpy as jnp
 
-    if interpret is None:
-        interpret = jax.default_backend() not in ("tpu",)
     T = len(values)
     n_rows = max(1, (T + LANES - 1) // LANES)
     padded = np.zeros(n_rows * LANES, np.float32)
     padded[:T] = values
     B = len(starts)
-    run = _build(n_rows, B, bool(interpret))
-    out = run(jnp.asarray(starts, jnp.int32), jnp.asarray(ends, jnp.int32),
-              jnp.asarray(padded.reshape(n_rows, LANES)))
+    out = window_sums_device(jnp.asarray(padded),
+                             jnp.asarray(starts, jnp.int32),
+                             jnp.asarray(ends, jnp.int32), interpret)
     return np.asarray(out)[:B, 0]
+
+
+def window_sums_device(values, starts, ends, interpret: bool = None):
+    """Async variant for the engine's dispatch path: returns the
+    on-device [B_pad, LANES] output (column 0 holds the sums) without
+    a host round trip.  ``values`` must already be padded to a multiple
+    of LANES rows; starts/ends int32 device-or-host arrays."""
+    import jax
+
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu",)
+    n_rows = values.shape[0] // LANES
+    run = _build(n_rows, len(starts), bool(interpret))
+    return run(starts, ends, values.reshape(n_rows, LANES))

@@ -30,6 +30,7 @@ falls out of JAX's asynchronous dispatch.
 from __future__ import annotations
 
 import functools
+import os
 import threading
 from typing import Any, Callable, Dict, Optional
 
@@ -263,6 +264,7 @@ def _use_pallas_ffat(t_pad: int) -> bool:
 # (t_pad, b_pad) shapes whose pallas lowering failed; those shapes fall
 # back to the XLA query permanently (first failure logged)
 _PALLAS_FFAT_BROKEN: set = set()
+_PALLAS_WINSUM_BROKEN: set = set()
 
 
 @functools.lru_cache(maxsize=None)
@@ -428,6 +430,31 @@ class WindowComputeEngine:
             prog = _sparse_table_program(self.kind, n_levels)
             dev = prog(jnp.asarray(pad_col(cols[self.value_col], fill)),
                        jnp.asarray(se))
+        elif (self.kind == "sum"
+              and os.environ.get("WINDFLOW_PALLAS_WINSUM") == "1"
+              and T_pad <= _PALLAS_FFAT_MAX_T and B_pad <= (1 << 15)
+              and (T_pad, B_pad) not in _PALLAS_WINSUM_BROKEN):
+            # hand-scheduled Pallas alternative to the XLA sum paths
+            # (the ComputeBatch_Kernel twin): grid program per window,
+            # scalar-prefetched extents.  T_pad/B_pad are powers of two
+            # >= 2048, so the lane/row alignment holds by construction;
+            # the size gate keeps the unblocked VMEM mapping in budget
+            # and a lowering failure falls back to the XLA path for
+            # that shape permanently (like the FFAT kernel).
+            from .pallas.window_sum import window_sums_device
+            try:
+                dev = window_sums_device(
+                    jnp.asarray(pad_col(cols[self.value_col])),
+                    jnp.asarray(se[0]), jnp.asarray(se[1]))[:, 0]
+            except Exception as e:
+                _PALLAS_WINSUM_BROKEN.add((T_pad, B_pad))
+                import warnings
+                warnings.warn(
+                    f"pallas window-sum lowering failed for shape "
+                    f"(T={T_pad}, B={B_pad}); using XLA path: {e!r}")
+                dev = _scan_program("sum")(
+                    jnp.asarray(pad_col(cols[self.value_col])),
+                    jnp.asarray(se))
         else:
             wp = next_pow2(max(int((ends - starts).max()) if B else 1, 2))
             prog = (_tile_sum_program(wp)
