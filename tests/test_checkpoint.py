@@ -483,3 +483,48 @@ def test_collector_columnar_checkpoint_midstream(cls):
     assert flat(out1 + out2) == flat(ref_out)
     if cls == "kslack":
         assert b2.dropped == ref.dropped
+
+
+def test_quiesce_requires_running_graph():
+    import windflow_tpu as wf
+    g = wf.PipeGraph("q")
+    with pytest.raises(RuntimeError, match="running"):
+        g.quiesce()
+
+
+def test_live_checkpoint_after_sources_finished(tmp_path):
+    """Sources that already ended cannot ack a pause; the barrier must
+    still drain and snapshot (0 alive sources is a valid state)."""
+    import time
+    import windflow_tpu as wf
+    from windflow_tpu.core import BasicRecord
+
+    state = {"i": 0}
+
+    def fn(shipper, ctx):
+        i = state["i"]
+        if i >= 500:
+            return False
+        shipper.push(BasicRecord(i % 2, i // 2, i // 2, 1.0))
+        state["i"] = i + 1
+        return True
+
+    done = {"n": 0}
+
+    def sink(rec):
+        if rec is not None:
+            done["n"] += 1
+
+    g = wf.PipeGraph("lc")
+    op = wf.WinSeqTPUBuilder("sum").with_tb_windows(16, 8).build()
+    g.add_source(wf.SourceBuilder(fn).build()) \
+        .add(op).add_sink(wf.SinkBuilder(sink).build())
+    g.start()
+    deadline = time.monotonic() + 20
+    while state["i"] < 500 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    n = g.live_checkpoint(str(tmp_path / "s.pkl"))
+    assert n >= 1
+    g.resume()
+    g.wait_end()
+    assert done["n"] > 0
