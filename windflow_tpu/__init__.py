@@ -36,6 +36,12 @@ def __getattr__(name):
         "PipeGraph": "windflow_tpu.graph.pipegraph",
         "NodeFailureError": "windflow_tpu.graph.pipegraph",
         "MultiPipe": "windflow_tpu.graph.multipipe",
+        # mesh-scale operators + mesh construction (multi-chip plane)
+        "KeyFarmMesh": "windflow_tpu.operators.tpu.mesh_farm",
+        "PaneFarmMesh": "windflow_tpu.operators.tpu.pane_mesh",
+        "WinSeqFFATResident": "windflow_tpu.operators.tpu.ffat_resident",
+        "make_mesh": "windflow_tpu.parallel.mesh",
+        "make_multihost_mesh": "windflow_tpu.parallel.mesh",
     }
     builder_names = (
         "SourceBuilder", "FilterBuilder", "MapBuilder", "FlatMapBuilder",
