@@ -252,20 +252,38 @@ def expected_sum_of_events(events, win, slide):
     return sum(window_sums_of_events(events, win, slide).values())
 
 
-def test_triggering_delay_absorbs_disorder_exact():
+@pytest.mark.parametrize("kind", ["kf", "wf", "pf", "wmr", "kf_tpu"])
+def test_triggering_delay_absorbs_disorder_exact(kind):
     """A triggering delay covering the source's maximum disorder makes
     TB windows exact on an out-of-order stream (the DELAYED state,
     window.hpp:114): windows hold their fire until the delay passes, so
-    stragglers still land inside their windows."""
+    stragglers still land inside their windows -- the reference's _oop
+    variants, across every operator family."""
+    def build(par):
+        if kind == "kf":
+            return wf.KeyFarmBuilder(sum_win).with_parallelism(par) \
+                .with_tb_windows(50, 25, 500).build()
+        if kind == "wf":
+            return wf.WinFarmBuilder(sum_win).with_parallelism(par) \
+                .with_tb_windows(50, 25, 500).build()
+        if kind == "pf":
+            return wf.PaneFarmBuilder(sum_win, sum_win) \
+                .with_parallelism(par, 1) \
+                .with_tb_windows(50, 25, 500).build()
+        if kind == "wmr":
+            return wf.WinMapReduceBuilder(sum_win, sum_win) \
+                .with_parallelism(max(2, par), 1) \
+                .with_tb_windows(50, 25, 500).build()
+        return wf.KeyFarmTPUBuilder("sum").with_parallelism(par) \
+            .with_tb_windows(50, 25, 500).build()
+
     totals = []
     for par in (1, 3):
         sink = SumSink()
         g = wf.PipeGraph("det", Mode.DEFAULT)
         src = pareto_ooo_stream(N_KEYS, PER_KEY, jitter=4, seed=7)
-        op = wf.KeyFarmBuilder(sum_win).with_parallelism(par) \
-            .with_tb_windows(50, 25, 500).build()
         g.add_source(wf.SourceBuilder(src).build()) \
-            .add(op).add_sink(wf.SinkBuilder(sink).build())
+            .add(build(par)).add_sink(wf.SinkBuilder(sink).build())
         g.run()
         totals.append(sink.total)
     assert totals[0] == totals[1]
