@@ -21,6 +21,7 @@ Win_Seq_GPU does in the reference (win_farm_gpu.hpp:82-86).
 """
 from __future__ import annotations
 
+import time as _time
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -335,7 +336,6 @@ class WinSeqTPULogic(NodeLogic):
         window-result latency, emit."""
         handle, descs, birth = entry
         results = handle.block()
-        import time as _time
         if len(self.latency_samples) < 100_000:
             self.latency_samples.append(_time.perf_counter() - birth)
         if self.stats is not None:  # single-writer: dispatcher thread
@@ -364,7 +364,6 @@ class WinSeqTPULogic(NodeLogic):
             self.launched_batches += 1
             self.pending.append((handle, descs, birth))
         self._buffered_since_launch = 0
-        import time as _time
         self._last_launch_t = _time.perf_counter()
 
     def _flush_pending(self, emit, drain: bool = False) -> None:
@@ -539,7 +538,6 @@ class WinSeqTPULogic(NodeLogic):
         eng = self.engine
         if use_panes and kind == "count":
             eng = self._count_engine()
-        import time as _time
         birth = self._batch_birth or _time.perf_counter()
         self._batch_birth = None
         self._submit({"value": flat_vals}, starts, ends, gwids, descs,
@@ -575,8 +573,7 @@ class WinSeqTPULogic(NodeLogic):
             rts = (gwid * self.slide_len + self.win_len - 1
                    if self.win_type == WinType.TB else -1)  # CB: at launch
             if not self.descriptors:
-                import time as _time
-                self._batch_birth = _time.perf_counter()
+                        self._batch_birth = _time.perf_counter()
             self.descriptors.append((key, gwid, start, end, rts, key))
             st.next_fire += 1
             if len(self.descriptors) >= self.batch_len:
@@ -592,7 +589,6 @@ class WinSeqTPULogic(NodeLogic):
         if out is None:
             return
         vals, starts, ends, d_keys, d_gwids, d_rts = out[:6]
-        import time as _time
         birth = self._batch_birth or _time.perf_counter()
         # leftover ready windows (partial flush) restart the age clock
         self._batch_birth = (_time.perf_counter() if self._native.ready()
@@ -618,12 +614,10 @@ class WinSeqTPULogic(NodeLogic):
         return self._mean_eng
 
     def _launch_due(self) -> bool:
-        import time as _time
         return ((_time.perf_counter() - self._last_launch_t) * 1e3
                 >= self.max_batch_delay_ms)
 
     def _svc_batch_native(self, batch: TupleBatch, emit):
-        import time as _time
         ids = batch.id if self.win_type == WinType.CB else batch.ts
         ready = self._native.ingest(batch.key, ids, batch.ts,
                                     batch["value"])
