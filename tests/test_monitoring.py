@@ -166,3 +166,54 @@ def test_runtime_queue_stats_dump(tmp_path):
         assert r["puts"] >= r["gets"]
         assert r["residual"] == 0
         assert r["high_watermark"] >= 1
+
+
+def test_dashboard_http_webui(tmp_path):
+    """serve_http serves the self-contained HTML front-end at / and the
+    JSON snapshot at /apps (the reference's React dashboard analogue)."""
+    import urllib.request
+
+    from windflow_tpu.monitoring.dashboard import (DashboardServer,
+                                                   serve_http)
+
+    dash = DashboardServer(port=0)
+    dash.start()
+    httpd = serve_http(dash, port=0)
+    http_port = httpd.server_address[1]
+    try:
+        cfg = RuntimeConfig(tracing=True, log_dir=str(tmp_path),
+                            dashboard_port=dash.port)
+        g = small_graph(cfg)
+        g.run()
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}{path}", timeout=5) as r:
+                return r.headers["Content-Type"], r.read().decode()
+
+        ctype, html = get("/")
+        assert ctype.startswith("text/html")
+        # the page is self-contained: topology parser, sparkline, table
+        for marker in ("parseDot", "sparkline", "Device_launches",
+                       "/apps"):
+            assert marker in html, marker
+        # the type-2 deregister frame is applied by the dashboard's
+        # connection thread; poll until it lands rather than racing it
+        import time
+        deadline = time.time() + 5
+        while True:
+            ctype, body = get("/apps")
+            assert ctype.startswith("application/json")
+            apps = json.loads(body)
+            assert apps, "traced graph did not register"
+            (app,) = apps.values()
+            if not app["active"] or time.time() > deadline:
+                break
+            time.sleep(0.05)
+        assert "digraph" in app["diagram"]
+        assert app["report"]["PipeGraph_name"] == "traced"
+        assert not app["active"], "graph deregistered at wait_end"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        dash.stop()

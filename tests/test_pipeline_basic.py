@@ -175,3 +175,55 @@ def test_operator_reuse_rejected():
     g.add_source(src)
     with pytest.raises(RuntimeError, match="already used"):
         g.add_source(src)
+
+
+def test_builder_camelcase_surface():
+    """Every public builder exposes the reference's camelCase spellings
+    for its whole fluent surface, including methods inherited from the
+    shared window mixin (builders.hpp method census, SURVEY.md §2.7)."""
+    from windflow_tpu.builders import builders, builders_tpu
+
+    checked = 0
+    for mod in (builders, builders_tpu):
+        for bname in dir(mod):
+            cls = getattr(mod, bname)
+            if (not bname.endswith("Builder") or bname.startswith("_")
+                    or not isinstance(cls, type)):
+                continue
+            for sn in {n for k in cls.__mro__ for n in vars(k)
+                       if n.startswith("with_") or n == "build_ptr"}:
+                parts = sn.split("_")
+                camel = parts[0] + "".join(
+                    p.upper() if p in ("cb", "tb") else p.capitalize()
+                    for p in parts[1:])
+                assert getattr(cls, camel) is getattr(cls, sn), \
+                    f"{bname}.{camel} missing or diverged"
+                checked += 1
+    assert checked > 100, "alias census suspiciously small"
+    # spot-check literal reference spellings (builders.hpp) so the
+    # census cannot pass on a shared misspelling of the derivation rule
+    from windflow_tpu.builders.builders import (KeyFarmBuilder,
+                                                SourceBuilder, WinSeqBuilder)
+    from windflow_tpu.builders.builders_tpu import WinSeqTPUBuilder
+    for cls, names in [
+        (SourceBuilder, ["withName", "withParallelism",
+                         "withClosingFunction"]),
+        (WinSeqBuilder, ["withCBWindows", "withTBWindows"]),
+        (KeyFarmBuilder, ["withOptLevel"]),
+        (WinSeqTPUBuilder, ["withBatch", "withTPUConfiguration"]),
+    ]:
+        for n in names:
+            assert callable(getattr(cls, n)), f"{cls.__name__}.{n}"
+
+
+def test_builder_camelcase_window_methods_work():
+    """withCBWindows/withTBWindows (mixin-inherited, the round-4 alias
+    regression) actually build working operators."""
+    import windflow_tpu as wf
+
+    op = wf.KeyFarmBuilder("sum").withCBWindows(64, 32) \
+        .withParallelism(2).build()
+    assert op.win_len == 64 and op.slide_len == 32
+    op2 = wf.WinFarmBuilder("sum").withTBWindows(1000, 500) \
+        .withParallelism(2).build()
+    assert op2.win_len == 1000 and op2.slide_len == 500

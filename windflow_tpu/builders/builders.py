@@ -19,14 +19,21 @@ from ..operators.win_seq import WinSeq
 
 
 def _alias_camel(cls):
-    """Attach camelCase aliases for every with_/build method."""
-    for name in list(vars(cls)):
-        if name.startswith("with_") or name in ("build_ptr",):
-            parts = name.split("_")
-            camel = parts[0] + "".join(p.upper() if p in ("cb", "tb")
-                                       else p.capitalize()
-                                       for p in parts[1:])
-            setattr(cls, camel, vars(cls)[name])
+    """Attach camelCase aliases for every with_/build method, including
+    ones inherited from mixins (the window-parameter surface lives on a
+    shared base, so walk the MRO, nearest definition winning)."""
+    targets = {}
+    for klass in cls.__mro__:
+        for name, fn in vars(klass).items():
+            if name not in targets and (name.startswith("with_")
+                                        or name in ("build_ptr",)):
+                targets[name] = fn
+    for name, fn in targets.items():
+        parts = name.split("_")
+        camel = parts[0] + "".join(p.upper() if p in ("cb", "tb")
+                                   else p.capitalize()
+                                   for p in parts[1:])
+        setattr(cls, camel, fn)
     return cls
 
 

@@ -30,7 +30,7 @@ class DashboardServer(threading.Thread):
         self.lock = threading.Lock()
         self.apps: Dict[int, dict] = {}
         self._next_id = 1
-        self._stop = threading.Event()
+        self._stop_evt = threading.Event()
 
     # -- framed protocol (mirror of monitoring.hpp:232-313) ---------------
     @staticmethod
@@ -84,7 +84,7 @@ class DashboardServer(threading.Thread):
 
     def run(self) -> None:
         self.server.settimeout(0.5)
-        while not self._stop.is_set():
+        while not self._stop_evt.is_set():
             try:
                 conn, _ = self.server.accept()
             except socket.timeout:
@@ -95,7 +95,7 @@ class DashboardServer(threading.Thread):
                              daemon=True).start()
 
     def stop(self) -> None:
-        self._stop.set()
+        self._stop_evt.set()
         self.server.close()
         self.join(timeout=2)
 
@@ -105,13 +105,22 @@ class DashboardServer(threading.Thread):
 
 
 def serve_http(dash: DashboardServer, port: int = 20208):
-    """Expose the dashboard state as JSON over HTTP."""
+    """Expose the dashboard over HTTP: the self-contained HTML
+    front-end at ``/`` (webui.py -- the React-dashboard equivalent) and
+    the JSON state at ``/apps`` (and any other path, kept permissive
+    for curl users)."""
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
-            body = json.dumps(dash.snapshot()).encode()
+            if self.path in ("/", "/index.html"):
+                from .webui import HTML_PAGE
+                body = HTML_PAGE.encode()
+                ctype = "text/html; charset=utf-8"
+            else:
+                body = json.dumps(dash.snapshot()).encode()
+                ctype = "application/json"
             self.send_response(200)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

@@ -1,0 +1,258 @@
+"""Self-contained HTML front-end for the dashboard.
+
+The reference's dashboard is a Java Spring + React app (README "Web
+Dashboard"; its directory is empty in the snapshot).  This module is
+the renderer-free equivalent: one dependency-free HTML page, served by
+``dashboard.serve_http`` at ``/``, that polls the ``/apps`` JSON
+snapshot once a second and renders
+
+* per-app stat tiles (throughput, memory, dropped tuples, replicas),
+* the PipeGraph topology (parsed client-side from the DOT diagram the
+  MonitoringThread registers -- multipipe.hpp:522-591 equivalent),
+* a throughput sparkline built from successive report deltas,
+* the per-operator replica table (stats_record.hpp:45-165 counters).
+
+No external assets: the page must work on an air-gapped TPU VM.
+"""
+
+HTML_PAGE = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>WindFlow-TPU dashboard</title>
+<style>
+  .viz-root {
+    color-scheme: light;
+    --surface-1: #fcfcfb; --surface-2: #f1f0ee;
+    --text-primary: #0b0b0b; --text-secondary: #52514e;
+    --series-1: #2a78d6; --grid: #e3e2df;
+    --status-good: #008300; --status-serious: #e34948;
+  }
+  @media (prefers-color-scheme: dark) {
+    :root:where(:not([data-theme="light"])) .viz-root {
+      color-scheme: dark;
+      --surface-1: #1a1a19; --surface-2: #242423;
+      --text-primary: #ffffff; --text-secondary: #c3c2b7;
+      --series-1: #3987e5; --grid: #33332f;
+      --status-good: #35b559; --status-serious: #e66767;
+    }
+  }
+  body { margin: 0; }
+  .viz-root {
+    font: 14px/1.45 system-ui, sans-serif; background: var(--surface-1);
+    color: var(--text-primary); min-height: 100vh; padding: 20px 24px;
+    box-sizing: border-box;
+  }
+  h1 { font-size: 17px; font-weight: 600; margin: 0 0 4px; }
+  .sub { color: var(--text-secondary); font-size: 12px; margin: 0 0 18px; }
+  .app { border: 1px solid var(--grid); border-radius: 8px;
+         padding: 14px 16px; margin-bottom: 16px; }
+  .app h2 { font-size: 14px; font-weight: 600; margin: 0 8px 0 0;
+            display: inline-block; }
+  .badge { font-size: 11px; border-radius: 9px; padding: 1px 8px;
+           vertical-align: 1px; }
+  .badge.live  { color: var(--status-good);
+                 border: 1px solid var(--status-good); }
+  .badge.ended { color: var(--status-serious);
+                 border: 1px solid var(--status-serious); }
+  .tiles { display: flex; flex-wrap: wrap; gap: 10px; margin: 12px 0; }
+  .tile { background: var(--surface-2); border-radius: 6px;
+          padding: 8px 14px; min-width: 110px; }
+  .tile .v { font-size: 20px; font-weight: 600; font-variant-numeric:
+             tabular-nums; }
+  .tile .k { color: var(--text-secondary); font-size: 11px; }
+  svg text { fill: var(--text-secondary); font: 11px system-ui, sans-serif; }
+  .topo rect { fill: var(--surface-2); stroke: var(--grid); rx: 4; }
+  .topo text.op { fill: var(--text-primary); }
+  .topo path { stroke: var(--text-secondary); fill: none;
+               stroke-width: 1.2; }
+  table { border-collapse: collapse; width: 100%; margin-top: 10px;
+          font-variant-numeric: tabular-nums; }
+  th { text-align: right; color: var(--text-secondary); font-weight: 500;
+       font-size: 11px; padding: 4px 10px; border-bottom: 1px solid
+       var(--grid); }
+  th:first-child, td:first-child { text-align: left; }
+  td { text-align: right; padding: 4px 10px; border-bottom: 1px solid
+       var(--grid); }
+  .spark-wrap { position: relative; margin-top: 6px; }
+  #tip { position: fixed; pointer-events: none; display: none;
+         background: var(--surface-2); border: 1px solid var(--grid);
+         border-radius: 4px; padding: 2px 8px; font-size: 11px;
+         color: var(--text-primary); z-index: 9; }
+</style>
+</head>
+<body>
+<div class="viz-root">
+  <h1>WindFlow-TPU dashboard</h1>
+  <p class="sub">polling <code>/apps</code> every second &mdash; framed-TCP
+  ingest from traced PipeGraphs (RuntimeConfig.tracing)</p>
+  <div id="apps"><p class="sub">no applications registered yet</p></div>
+  <div id="tip"></div>
+</div>
+<script>
+"use strict";
+const hist = {};           // app id -> [{t, outputs}] report-delta history
+const fmt = n => n >= 1e9 ? (n / 1e9).toFixed(2) + "B"
+             : n >= 1e6 ? (n / 1e6).toFixed(2) + "M"
+             : n >= 1e3 ? (n / 1e3).toFixed(1) + "k" : String(n);
+// names come off the wire (any local process can register an app) --
+// escape everything interpolated into innerHTML
+const esc = s => String(s).replace(/[&<>"']/g, c => ({"&": "&amp;",
+  "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;"}[c]));
+
+function parseDot(src) {
+  const nodes = [], labels = {}, edges = [];
+  for (const line of (src || "").split("\\n")) {
+    let m = line.match(/^\\s*(\\w+)\\s*\\[label="([^"]*)"/);
+    if (m) { nodes.push(m[1]); labels[m[1]] = m[2]; continue; }
+    m = line.match(/^\\s*(\\w+)\\s*->\\s*(\\w+)/);
+    if (m) edges.push([m[1], m[2]]);
+  }
+  return { nodes, labels, edges };
+}
+
+function topoSvg(g) {
+  if (!g.nodes.length) return "";
+  const depth = {};                       // longest path from a root
+  for (let pass = 0; pass <= g.nodes.length; pass++)
+    for (const [a, b] of g.edges)
+      depth[b] = Math.max(depth[b] || 0, (depth[a] || 0) + 1);
+  const cols = {};
+  for (const n of g.nodes) (cols[depth[n] || 0] ||= []).push(n);
+  const CW = 148, RH = 40, pos = {};
+  let H = 0;
+  for (const [c, ns] of Object.entries(cols)) {
+    ns.forEach((n, i) => pos[n] = [8 + c * CW, 8 + i * RH]);
+    H = Math.max(H, ns.length * RH);
+  }
+  const W = 8 + (Object.keys(cols).length) * CW;
+  let s = `<svg class="topo" width="${W}" height="${H + 10}"
+    role="img" aria-label="pipeline topology">`;
+  for (const [a, b] of g.edges) {
+    const [x1, y1] = pos[a], [x2, y2] = pos[b];
+    s += `<path d="M ${x1 + 128} ${y1 + 13} C ${x1 + 140} ${y1 + 13},
+      ${x2 - 12} ${y2 + 13}, ${x2} ${y2 + 13}" />`;
+  }
+  for (const n of g.nodes) {
+    const [x, y] = pos[n], lab = g.labels[n] || n;
+    s += `<rect x="${x}" y="${y}" width="128" height="26" rx="4"></rect>
+      <text class="op" x="${x + 64}" y="${y + 17}" text-anchor="middle">
+      ${esc(lab.length > 18 ? lab.slice(0, 17) + "\\u2026" : lab)}</text>`;
+  }
+  return s + "</svg>";
+}
+
+function sparkline(id, h) {
+  if (h.length < 2) return "";
+  const W = 320, H = 48, rates = [];
+  for (let i = 1; i < h.length; i++) {
+    const dt = (h[i].t - h[i - 1].t) / 1000 || 1;
+    rates.push(Math.max(0, (h[i].outputs - h[i - 1].outputs) / dt));
+  }
+  const mx = Math.max(...rates, 1);
+  const pts = rates.map((r, i) =>
+    [8 + i * (W - 16) / Math.max(1, rates.length - 1),
+     H - 6 - r / mx * (H - 16), r]);
+  let s = `<svg width="${W}" height="${H}" data-app="${esc(id)}"
+    class="spark" role="img" aria-label="output rate">`;
+  s += `<line x1="8" y1="${H - 6}" x2="${W - 8}" y2="${H - 6}"
+    stroke="var(--grid)" />`;
+  s += `<polyline fill="none" stroke="var(--series-1)" stroke-width="2"
+    points="${pts.map(p => p[0].toFixed(1) + "," + p[1].toFixed(1)).join(" ")}" />`;
+  const last = pts[pts.length - 1];
+  s += `<circle cx="${last[0]}" cy="${last[1]}" r="3"
+    fill="var(--series-1)" />`;
+  s += `<text x="${W - 8}" y="10" text-anchor="end">${fmt(last[2])}/s</text>`;
+  return s + "</svg>";
+}
+
+function hookHover() {
+  const tip = document.getElementById("tip");
+  document.querySelectorAll("svg.spark").forEach(sv => {
+    sv.onmousemove = e => {
+      const h = hist[sv.dataset.app] || [];
+      if (h.length < 2) return;
+      const r = sv.getBoundingClientRect();
+      const i = Math.min(h.length - 2, Math.max(0, Math.round(
+        (e.clientX - r.left - 8) / (r.width - 16) * (h.length - 2))));
+      const dt = (h[i + 1].t - h[i].t) / 1000 || 1;
+      tip.textContent = fmt((h[i + 1].outputs - h[i].outputs) / dt)
+        + " results/s";
+      tip.style.left = (e.clientX + 12) + "px";
+      tip.style.top = (e.clientY - 10) + "px";
+      tip.style.display = "block";
+    };
+    sv.onmouseleave = () => tip.style.display = "none";
+  });
+}
+
+function opRow(op) {
+  const rs = op.Replicas || [];
+  const sum = k => rs.reduce((a, r) => a + (r[k] || 0), 0);
+  const svc = rs.length ?
+    rs.reduce((a, r) => a + (r.Service_time_usec || 0), 0) / rs.length : 0;
+  return `<tr><td>${esc(op.Operator_name)}</td><td>${op.Parallelism}</td>
+    <td>${fmt(sum("Inputs_received"))}</td>
+    <td>${fmt(sum("Outputs_sent"))}</td>
+    <td>${fmt(sum("Inputs_ignored"))}</td>
+    <td>${svc.toFixed(1)}</td>
+    <td>${fmt(sum("Device_launches"))}</td>
+    <td>${fmt(sum("Bytes_to_device"))}</td>
+    <td>${fmt(sum("Bytes_from_device"))}</td></tr>`;
+}
+
+function render(apps) {
+  const root = document.getElementById("apps");
+  const ids = Object.keys(apps);
+  if (!ids.length) return;
+  root.innerHTML = ids.map(id => {
+    const a = apps[id], rep = a.report || {};
+    const ops = rep.Operators || [];
+    const outputs = ops.length ?          // sink row: results RECEIVED
+      (ops[ops.length - 1].Replicas || []).reduce(
+        (s, r) => s + (r.Inputs_received || 0), 0) : 0;
+    (hist[id] ||= []).push({ t: Date.now(), outputs });
+    if (hist[id].length > 120) hist[id].shift();
+    const replicas = ops.reduce((s, o) => s + (o.Parallelism || 0), 0);
+    const h = hist[id], rate = h.length > 1 ?
+      Math.max(0, (h[h.length - 1].outputs - h[h.length - 2].outputs) /
+        ((h[h.length - 1].t - h[h.length - 2].t) / 1000 || 1)) : 0;
+    return `<div class="app">
+      <h2>#${esc(id)} ${esc(rep.PipeGraph_name || "(no report yet)")}</h2>
+      <span class="badge ${a.active ? "live" : "ended"}">
+        ${a.active ? "\\u25cf live" : "\\u25a0 ended"}</span>
+      <div class="tiles">
+        <div class="tile"><div class="v">${fmt(rate)}/s</div>
+          <div class="k">result rate at sink</div></div>
+        <div class="tile"><div class="v">${fmt(outputs)}</div>
+          <div class="k">results received</div></div>
+        <div class="tile"><div class="v">${fmt(rep.Dropped_tuples || 0)}
+          </div><div class="k">dropped tuples</div></div>
+        <div class="tile"><div class="v">${replicas}</div>
+          <div class="k">replicas (${rep.Operator_number || 0} ops)</div></div>
+        <div class="tile"><div class="v">
+          ${fmt((rep.Memory_usage_KB || 0) * 1024)}B</div>
+          <div class="k">resident memory</div></div>
+      </div>
+      ${topoSvg(parseDot(a.diagram))}
+      <div class="spark-wrap">${sparkline(id, hist[id])}</div>
+      <table><thead><tr><th>operator</th><th>par</th><th>in</th>
+        <th>out</th><th>ignored</th><th>svc &micro;s</th>
+        <th>launches</th><th>B&rarr;dev</th><th>B&larr;dev</th></tr>
+      </thead><tbody>${ops.map(opRow).join("")}</tbody></table>
+    </div>`;
+  }).join("");
+  hookHover();
+}
+
+async function tick() {
+  try {
+    const r = await fetch("/apps");
+    render(await r.json());
+  } catch (e) { /* server restarting */ }
+}
+setInterval(tick, 1000); tick();
+</script>
+</body>
+</html>
+"""
