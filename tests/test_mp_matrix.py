@@ -57,7 +57,8 @@ def prefix_ops(rnd):
             wf.MapBuilder(ident).with_parallelism(rnd.randint(1, 3)).build())
 
 
-def build_window_op(kind, win_type, par, rnd):
+def build_window_op(kind, win_type, par, rnd, win=None):
+    win = WIN if win is None else win
     if kind == "wf":
         b = wf.WinFarmBuilder(sum_win).with_parallelism(par)
     elif kind == "kf":
@@ -75,48 +76,49 @@ def build_window_op(kind, win_type, par, rnd):
             .with_parallelism(max(2, par), 1)
     elif kind == "kf+pf":
         inner = wf.PaneFarmBuilder(sum_win, sum_win).with_parallelism(2, 1) \
-            .with_tb_windows(WIN, SLIDE).build() if win_type == WinType.TB \
+            .with_tb_windows(win, SLIDE).build() if win_type == WinType.TB \
             else wf.PaneFarmBuilder(sum_win, sum_win).with_parallelism(2, 1) \
-            .with_cb_windows(WIN, SLIDE).build()
+            .with_cb_windows(win, SLIDE).build()
         return wf.KeyFarmBuilder(inner).with_parallelism(par).build()
     elif kind == "wf+pf":
         inner = _with_wins(wf.PaneFarmBuilder(sum_win, sum_win)
-                           .with_parallelism(2, 1), win_type).build()
+                           .with_parallelism(2, 1), win_type, win).build()
         return wf.WinFarmBuilder(inner).with_parallelism(par).build()
     elif kind == "wf+wmr":
         inner = _with_wins(wf.WinMapReduceBuilder(sum_win, sum_win)
-                           .with_parallelism(2, 1), win_type).build()
+                           .with_parallelism(2, 1), win_type, win).build()
         return wf.WinFarmBuilder(inner).with_parallelism(par).build()
     elif kind == "kf+wmr":
         inner = _with_wins(wf.WinMapReduceBuilder(sum_win, sum_win)
-                           .with_parallelism(2, 1), win_type).build()
+                           .with_parallelism(2, 1), win_type, win).build()
         return wf.KeyFarmBuilder(inner).with_parallelism(par).build()
     # device-side complex nesting (win_farm_gpu.hpp:73-76,
     # key_farm_gpu.hpp:254): the inner device stage runs builtin 'sum'
     elif kind == "wf+pf_tpu":
         inner = _with_wins(wf.PaneFarmTPUBuilder("sum", sum_win)
-                           .with_parallelism(2, 1), win_type).build()
+                           .with_parallelism(2, 1), win_type, win).build()
         return wf.WinFarmTPUBuilder(inner).with_parallelism(par).build()
     elif kind == "kf+pf_tpu":
         inner = _with_wins(wf.PaneFarmTPUBuilder("sum", sum_win)
-                           .with_parallelism(2, 1), win_type).build()
+                           .with_parallelism(2, 1), win_type, win).build()
         return wf.KeyFarmTPUBuilder(inner).with_parallelism(par).build()
     elif kind == "wf+wmr_tpu":
         inner = _with_wins(wf.WinMapReduceTPUBuilder("sum", sum_win)
-                           .with_parallelism(2, 1), win_type).build()
+                           .with_parallelism(2, 1), win_type, win).build()
         return wf.WinFarmTPUBuilder(inner).with_parallelism(par).build()
     elif kind == "kf+wmr_tpu":
         inner = _with_wins(wf.WinMapReduceTPUBuilder("sum", sum_win)
-                           .with_parallelism(2, 1), win_type).build()
+                           .with_parallelism(2, 1), win_type, win).build()
         return wf.KeyFarmTPUBuilder(inner).with_parallelism(par).build()
     else:
         raise ValueError(kind)
-    return _with_wins(b, win_type).build()
+    return _with_wins(b, win_type, win).build()
 
 
-def _with_wins(builder, win_type):
-    return (builder.with_tb_windows(WIN, SLIDE) if win_type == WinType.TB
-            else builder.with_cb_windows(WIN, SLIDE))
+def _with_wins(builder, win_type, win=None):
+    win = WIN if win is None else win
+    return (builder.with_tb_windows(win, SLIDE) if win_type == WinType.TB
+            else builder.with_cb_windows(win, SLIDE))
 
 
 def expected_total(per_key, n_keys, win, slide):
@@ -137,16 +139,22 @@ def expected_total(per_key, n_keys, win, slide):
 @pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
 def test_matrix_randomized_parallelism(kind, win_type):
     """The core oracle: R randomized repetitions with different random
-    parallelisms (mp_tests style, test_mp_gpu_kff_cb.cpp:81-95), totals
-    must match each other and the sequential expectation.  Streams run
-    long enough (48 windows/key) to cross archive-purge and renumber
-    boundaries at every parallelism."""
+    parallelisms (mp_tests style, test_mp_gpu_kff_cb.cpp:81-95, which
+    draws 1..9), totals must match each other and the sequential
+    expectation.  Streams run long enough (96 windows/key) that even a
+    parallelism-9 farm gives every worker >= 10 windows, crossing
+    archive-purge and renumber boundaries on each."""
     # the parallel prefix destroys per-key order, so the matrix runs in
     # DETERMINISTIC mode (ordering collectors); the DEFAULT-mode
     # renumbering path has its own dedicated test below with tumbling
     # windows, whose totals are arrival-order invariant.
     mode = Mode.DETERMINISTIC
-    per_key = 240
+    per_key = 480
+    # WF(PF) copies run with private slide = SLIDE * outer_par, and
+    # Pane_Farm requires slide < win (pane_farm.hpp:170-173) -- the
+    # pf-in-WF kinds get a window wide enough to stay valid at
+    # parallelism 9
+    win = 50 if kind in ("wf+pf", "wf+pf_tpu") else WIN
     totals = []
     for trial in range(3):
         # crc32, not hash(): PYTHONHASHSEED randomizes hash() per run,
@@ -159,8 +167,8 @@ def test_matrix_randomized_parallelism(kind, win_type):
         # trial 0 always runs the outer farm at parallelism >= 2 so
         # nesting arithmetic is exercised every run
         op = build_window_op(kind, win_type,
-                             rnd.randint(2, 4) if trial == 0
-                             else rnd.randint(1, 4), rnd)
+                             rnd.randint(2, 9) if trial == 0
+                             else rnd.randint(1, 9), rnd, win)
         pipe = g.add_source(wf.SourceBuilder(
             ordered_keyed_stream(N_KEYS, per_key)).build())
         if mode == Mode.DEFAULT:
@@ -171,7 +179,7 @@ def test_matrix_randomized_parallelism(kind, win_type):
         g.run()
         totals.append(sink.total)
     assert totals[0] == totals[1] == totals[2] == \
-        expected_total(per_key, N_KEYS, WIN, SLIDE)
+        expected_total(per_key, N_KEYS, win, SLIDE)
 
 
 @pytest.mark.parametrize("kind", ["kf", "kff", "wf", "pf", "wmr"])
@@ -373,11 +381,13 @@ def test_cb_broadcast_plane_filtered_prefix(kind):
 
     per_key = 90
     survivors = [float(v) for v in range(per_key) if v % 3 != 0]
+    # wf+pf needs win > SLIDE * outer_par (pane_farm.hpp:170-173)
+    win = 20 if kind == "wf+pf" else WIN
 
     def expect_total():
         total, g = 0.0, 0
         while g * SLIDE < len(survivors):
-            total += sum(survivors[g * SLIDE: g * SLIDE + WIN])
+            total += sum(survivors[g * SLIDE: g * SLIDE + win])
             g += 1
         return total * N_KEYS
 
@@ -385,7 +395,7 @@ def test_cb_broadcast_plane_filtered_prefix(kind):
     for par in (2, 3):
         sink = SumSink()
         g = wf.PipeGraph("cbf", Mode.DETERMINISTIC)
-        op = build_window_op(kind, WinType.CB, par, random.Random(0))
+        op = build_window_op(kind, WinType.CB, par, random.Random(0), win)
         g.add_source(wf.SourceBuilder(
             ordered_keyed_stream(N_KEYS, per_key)).build()) \
             .add(wf.FilterBuilder(keep).build()) \

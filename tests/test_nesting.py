@@ -68,7 +68,7 @@ def run_graph(op, n_keys=3, per_key=48, mode=Mode.DEFAULT):
     return coll
 
 
-WIN, SLIDE = 12, 4
+WIN, SLIDE = 16, 4
 
 
 def make_pf(pars=(2, 1), win_type=WinType.TB):
@@ -173,3 +173,27 @@ def test_tpu_nesting_builds_device_replicas():
     stages2 = op2.stages()
     assert len(stages2[0].replicas) == 6  # 3 copies x map_par 2
     assert all(isinstance(r, WinSeqTPULogic) for r in stages2[0].replicas)
+
+
+def test_wf_pf_degenerate_private_slide_rejected():
+    """WF(PF) where the copies' private slide (slide * replicas) would
+    reach the window length must fail loudly at construction, exactly
+    like the reference (pane_farm.hpp:170-173 via win_farm.hpp:326):
+    the pane decomposition silently miscomputes in that regime."""
+    with pytest.raises(ValueError, match="private slide"):
+        wf.WinFarmBuilder(make_pf()).with_parallelism(WIN // SLIDE).build()
+    pf_tpu = wf.PaneFarmTPUBuilder("sum", sum_win).with_parallelism(2, 1) \
+        .with_tb_windows(WIN, SLIDE).build()
+    with pytest.raises(ValueError, match="private slide"):
+        wf.WinFarmTPUBuilder(pf_tpu).with_parallelism(WIN // SLIDE).build()
+
+
+def test_pane_farm_tumbling_rejected():
+    """Standalone Pane_Farm with slide >= win is rejected
+    (pane_farm.hpp:170-173 'sliding windows only'), host and device."""
+    with pytest.raises(ValueError, match="sliding"):
+        wf.PaneFarmBuilder(sum_win, sum_win).with_parallelism(2, 1) \
+            .with_tb_windows(8, 8).build()
+    with pytest.raises(ValueError, match="sliding"):
+        wf.PaneFarmTPUBuilder("sum", sum_win).with_parallelism(1, 1) \
+            .with_tb_windows(8, 8).build()

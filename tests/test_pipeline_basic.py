@@ -194,7 +194,7 @@ def test_builder_camelcase_surface():
                        if n.startswith("with_") or n == "build_ptr"}:
                 parts = sn.split("_")
                 camel = parts[0] + "".join(
-                    p.upper() if p in ("cb", "tb") else p.capitalize()
+                    p.upper() if p in ("cb", "tb", "tpu") else p.capitalize()
                     for p in parts[1:])
                 assert getattr(cls, camel) is getattr(cls, sn), \
                     f"{bname}.{camel} missing or diverged"

@@ -102,7 +102,7 @@ def test_key_farm_matches_oracle(win, slide, par, win_type):
     assert coll.by_key() == {k: expect for k in range(5)}
 
 
-@pytest.mark.parametrize("win,slide", [(8, 8), (12, 4), (10, 5)])
+@pytest.mark.parametrize("win,slide", [(8, 2), (12, 4), (10, 5)])
 @pytest.mark.parametrize("pars", [(1, 1), (2, 2), (3, 1)])
 @pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
 def test_pane_farm_matches_oracle(win, slide, pars, win_type):

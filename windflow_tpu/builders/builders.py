@@ -30,7 +30,7 @@ def _alias_camel(cls):
                 targets[name] = fn
     for name, fn in targets.items():
         parts = name.split("_")
-        camel = parts[0] + "".join(p.upper() if p in ("cb", "tb")
+        camel = parts[0] + "".join(p.upper() if p in ("cb", "tb", "tpu")
                                    else p.capitalize()
                                    for p in parts[1:])
         setattr(cls, camel, fn)

@@ -25,18 +25,12 @@ class _TPUBuilderMixin:
         self.batch_len = batch_len
         return self
 
-    withBatch = with_batch
-
     def with_tpu_configuration(self, device_index: int = 0):
         self.device_index = device_index
         return self
 
-    withTPUConfiguration = with_tpu_configuration
-
     def with_tpu(self):
         return self
-
-    withTPU = with_tpu
 
     def with_value_of(self, value_of: Callable[[Any], float]):
         """Host-side extractor tuple -> float fed to the device batch
@@ -44,14 +38,11 @@ class _TPUBuilderMixin:
         self.value_of = value_of
         return self
 
-    withValueOf = with_value_of
-
     def with_batch_output(self, on: bool = True):
         """Emit results as columnar TupleBatches (hot path)."""
         self.emit_batches = on
         return self
 
-    withBatchOutput = with_batch_output
 
 
 @_alias_camel

@@ -92,9 +92,13 @@ HTML_PAGE = """<!DOCTYPE html>
 <script>
 "use strict";
 const hist = {};           // app id -> [{t, outputs}] report-delta history
-const fmt = n => n >= 1e9 ? (n / 1e9).toFixed(2) + "B"
-             : n >= 1e6 ? (n / 1e6).toFixed(2) + "M"
-             : n >= 1e3 ? (n / 1e3).toFixed(1) + "k" : String(n);
+// counters come off the wire: coerce before arithmetic so a malformed
+// report cannot smuggle strings through the sums into the markup
+const num = v => { const n = Number(v); return isFinite(n) ? n : 0; };
+const fmt = v => { const n = num(v);
+  return n >= 1e9 ? (n / 1e9).toFixed(2) + "B"
+       : n >= 1e6 ? (n / 1e6).toFixed(2) + "M"
+       : n >= 1e3 ? (n / 1e3).toFixed(1) + "k" : String(n); };
 // names come off the wire (any local process can register an app) --
 // escape everything interpolated into innerHTML
 const esc = s => String(s).replace(/[&<>"']/g, c => ({"&": "&amp;",
@@ -129,6 +133,7 @@ function topoSvg(g) {
   let s = `<svg class="topo" width="${W}" height="${H + 10}"
     role="img" aria-label="pipeline topology">`;
   for (const [a, b] of g.edges) {
+    if (!pos[a] || !pos[b]) continue;   // edge to an undeclared node
     const [x1, y1] = pos[a], [x2, y2] = pos[b];
     s += `<path d="M ${x1 + 128} ${y1 + 13} C ${x1 + 140} ${y1 + 13},
       ${x2 - 12} ${y2 + 13}, ${x2} ${y2 + 13}" />`;
@@ -188,10 +193,10 @@ function hookHover() {
 
 function opRow(op) {
   const rs = op.Replicas || [];
-  const sum = k => rs.reduce((a, r) => a + (r[k] || 0), 0);
+  const sum = k => rs.reduce((a, r) => a + num(r[k]), 0);
   const svc = rs.length ?
-    rs.reduce((a, r) => a + (r.Service_time_usec || 0), 0) / rs.length : 0;
-  return `<tr><td>${esc(op.Operator_name)}</td><td>${op.Parallelism}</td>
+    rs.reduce((a, r) => a + num(r.Service_time_usec), 0) / rs.length : 0;
+  return `<tr><td>${esc(op.Operator_name)}</td><td>${num(op.Parallelism)}</td>
     <td>${fmt(sum("Inputs_received"))}</td>
     <td>${fmt(sum("Outputs_sent"))}</td>
     <td>${fmt(sum("Inputs_ignored"))}</td>
@@ -210,10 +215,10 @@ function render(apps) {
     const ops = rep.Operators || [];
     const outputs = ops.length ?          // sink row: results RECEIVED
       (ops[ops.length - 1].Replicas || []).reduce(
-        (s, r) => s + (r.Inputs_received || 0), 0) : 0;
+        (s, r) => s + num(r.Inputs_received), 0) : 0;
     (hist[id] ||= []).push({ t: Date.now(), outputs });
     if (hist[id].length > 120) hist[id].shift();
-    const replicas = ops.reduce((s, o) => s + (o.Parallelism || 0), 0);
+    const replicas = ops.reduce((s, o) => s + num(o.Parallelism), 0);
     const h = hist[id], rate = h.length > 1 ?
       Math.max(0, (h[h.length - 1].outputs - h[h.length - 2].outputs) /
         ((h[h.length - 1].t - h[h.length - 2].t) / 1000 || 1)) : 0;
@@ -229,9 +234,9 @@ function render(apps) {
         <div class="tile"><div class="v">${fmt(rep.Dropped_tuples || 0)}
           </div><div class="k">dropped tuples</div></div>
         <div class="tile"><div class="v">${replicas}</div>
-          <div class="k">replicas (${rep.Operator_number || 0} ops)</div></div>
+          <div class="k">replicas (${num(rep.Operator_number)} ops)</div></div>
         <div class="tile"><div class="v">
-          ${fmt((rep.Memory_usage_KB || 0) * 1024)}B</div>
+          ${fmt(num(rep.Memory_usage_KB) * 1024)}B</div>
           <div class="k">resident memory</div></div>
       </div>
       ${topoSvg(parseDot(a.diagram))}
@@ -246,10 +251,14 @@ function render(apps) {
 }
 
 async function tick() {
+  let apps;
   try {
     const r = await fetch("/apps");
-    render(await r.json());
-  } catch (e) { /* server restarting */ }
+    apps = await r.json();
+  } catch (e) { return; /* server restarting */ }
+  try {
+    render(apps);
+  } catch (e) { console.error("dashboard render:", e); }
 }
 setInterval(tick, 1000); tick();
 </script>

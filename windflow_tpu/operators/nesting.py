@@ -123,6 +123,19 @@ class NestedWinFarm(Operator):
         if inner.used:
             raise RuntimeError(
                 "inner operator already used in a nested structure")
+        if (isinstance(inner, (PaneFarm, PaneFarmTPU))
+                and inner.win_len <= inner.slide_len * num_replicas):
+            # each copy runs with private slide = slide * num_replicas
+            # (win_farm.hpp:326); Pane_Farm rejects slide >= win
+            # (pane_farm.hpp:170-173), so fail here, eagerly, with the
+            # nesting-level numbers
+            raise ValueError(
+                f"Win_Farm({num_replicas}) over a Pane_Farm with "
+                f"win={inner.win_len} slide={inner.slide_len}: the "
+                f"copies' private slide {inner.slide_len * num_replicas} "
+                f">= win; Pane_Farm requires sliding windows "
+                f"(pane_farm.hpp:170-173) -- reduce the replica count "
+                f"or widen the window")
         inner.used = True
         self.inner = inner
         self.num_replicas = num_replicas

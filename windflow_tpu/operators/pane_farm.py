@@ -40,6 +40,16 @@ class PaneFarm(Operator):
                          RoutingMode.COMPLEX, Pattern.PANE_FARM)
         if win_len == 0 or slide_len == 0:
             raise ValueError("window length and slide cannot be zero")
+        if win_len <= slide_len:
+            # pane_farm.hpp:170-173: with slide >= win the pane
+            # decomposition degenerates (the PLQ's dense pane
+            # renumbering no longer matches the WLQ's pane selection
+            # once the pane stream has gaps)
+            raise ValueError(
+                f"Pane_Farm requires sliding windows (slide < win); got "
+                f"win={win_len} slide={slide_len}. Inside a Win_Farm the "
+                f"private slide is slide*replicas, so nesting needs "
+                f"win > slide*replicas")
         self.plq_func = plq_func
         self.wlq_func = wlq_func
         self.win_len = win_len

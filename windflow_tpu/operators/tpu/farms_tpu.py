@@ -169,6 +169,14 @@ class PaneFarmTPU(_TPUWinOp):
             raise ValueError(
                 "exactly one of PLQ/WLQ must run on device "
                 "(pane_farm_gpu.hpp constraint, API:134)")
+        if win_len <= slide_len:
+            # pane_farm.hpp:170-173 (same check on the GPU twin): with
+            # slide >= win the pane decomposition degenerates
+            raise ValueError(
+                f"Pane_Farm requires sliding windows (slide < win); got "
+                f"win={win_len} slide={slide_len}. Inside a Win_Farm the "
+                f"private slide is slide*replicas, so nesting needs "
+                f"win > slide*replicas")
         self.plq = plq
         self.wlq = wlq
         self.win_len = win_len
