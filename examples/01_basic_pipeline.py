@@ -1,0 +1,59 @@
+"""Basic operators and the fluent builder API.
+
+Builds the canonical chain Source -> Filter -> FlatMap -> Map ->
+Accumulator -> Sink (the reference's `mp_tests` pipeline prefix plus a
+keyed rolling fold), using both spellings of the builder surface
+(snake_case and the reference's camelCase).
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+from examples._common import CountingSink, scale  # noqa: E402
+
+import windflow_tpu as wf  # noqa: E402
+from windflow_tpu.core import BasicRecord, Mode  # noqa: E402
+
+
+def main() -> CountingSink:
+    n, n_keys = scale(200_000), 8
+    state = {}
+
+    def src(shipper, ctx):
+        i = state.setdefault("i", 0)
+        if i >= n:
+            return False
+        shipper.push(BasicRecord(i % n_keys, i // n_keys, i, float(i)))
+        state["i"] = i + 1
+        return True
+
+    def odd_values_only(t):           # Filter: in-place predicate
+        return int(t.value) % 2 == 1
+
+    def duplicate(t, shipper):        # FlatMap: one-to-many via Shipper
+        shipper.push(t)
+        shipper.push(BasicRecord(t.key, t.id, t.ts, t.value / 1000.0))
+
+    def clamp(t):                     # Map: in-place transform
+        t.value = min(t.value, 1e6)
+
+    def rolling_sum(t, acc):          # Accumulator: keyed fold
+        acc.value += t.value
+
+    sink = CountingSink()
+    g = wf.PipeGraph("basic", Mode.DEFAULT)
+    g.add_source(wf.SourceBuilder(src).withName("events").build()) \
+        .chain(wf.FilterBuilder(odd_values_only).build()) \
+        .add(wf.FlatMapBuilder(duplicate).with_parallelism(2).build()) \
+        .chain(wf.MapBuilder(clamp).build()) \
+        .add(wf.AccumulatorBuilder(rolling_sum)
+             .withInitialValue(BasicRecord(0, 0, 0, 0.0)).build()) \
+        .add_sink(wf.SinkBuilder(sink).build())
+    g.run()
+    print(f"[01] {n} events -> {sink.count} rolling-fold updates, "
+          f"final running total {sink.total:,.1f}")
+    return sink
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Tracing + the live dashboard.
+
+Enable ``RuntimeConfig(tracing=True)`` and the graph reports per-replica
+statistics once a second over the framed TCP protocol
+(monitoring.hpp:232-313 equivalent).  This example hosts the bundled
+dashboard server in-process and leaves it up briefly so you can open
+the HTML front-end while the graph runs:
+
+    http://127.0.0.1:20208/        (the web UI)
+    http://127.0.0.1:20208/apps    (raw JSON snapshot)
+"""
+import json
+import os
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+from examples._common import CountingSink, scale  # noqa: E402
+
+import windflow_tpu as wf  # noqa: E402
+from windflow_tpu.core import BasicRecord, Mode, RuntimeConfig  # noqa: E402
+from windflow_tpu.monitoring.dashboard import (DashboardServer,  # noqa: E402
+                                               serve_http)
+
+
+def main():
+    n = scale(3_000_000)
+    dash = DashboardServer(port=0)
+    dash.start()
+    httpd = serve_http(dash, port=0)
+    port = httpd.server_address[1]
+    print(f"[07] dashboard up: http://127.0.0.1:{port}/ "
+          f"(ingest on :{dash.port})")
+
+    log_dir = Path(os.environ.get("WINDFLOW_LOG_DIR", "/tmp/windflow_logs"))
+    log_dir.mkdir(parents=True, exist_ok=True)
+    cfg = RuntimeConfig(tracing=True, log_dir=str(log_dir),
+                        dashboard_port=dash.port)
+    state = {}
+
+    def src(shipper, ctx):
+        i = state.setdefault("i", 0)
+        if i >= n:
+            return False
+        shipper.push(BasicRecord(i % 8, i // 8, i, float(i)))
+        state["i"] = i + 1
+        return True
+
+    def window_sum(gwid, it, result):
+        result.value = sum(t.value for t in it)
+
+    sink = CountingSink()
+    g = wf.PipeGraph("traced-demo", Mode.DEFAULT, cfg)
+    g.add_source(wf.SourceBuilder(src).build()) \
+        .add(wf.MapBuilder(lambda t: None).withParallelism(2).build()) \
+        .add(wf.KeyFarmBuilder(window_sum).withCBWindows(256, 128)
+             .withParallelism(2).build()) \
+        .add_sink(wf.SinkBuilder(sink).build())
+    g.run()
+
+    # report frames are parsed by the dashboard's connection thread;
+    # poll briefly instead of racing it (and tolerate tracing having
+    # been disabled if the 2 s register handshake timed out)
+    app = None
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        snap = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/apps", timeout=5))
+        if snap:
+            (app,) = snap.values()
+            if app["report"] is not None:
+                break
+        time.sleep(0.1)
+    if app is None or app["report"] is None:
+        print(f"[07] graph done: {sink.count} windows; dashboard "
+              f"received no report (register handshake timed out?)")
+    else:
+        ops = app["report"]["Operators"]
+        print(f"[07] graph done: {sink.count} windows; dashboard "
+              f"captured {len(ops)} operators, diagram "
+              f"{len(app['diagram'])} bytes")
+    if os.environ.get("WINDFLOW_EXAMPLES_SMALL") != "1":
+        print("[07] leaving the dashboard up for 15 s -- open the URL "
+              "above to see the final report")
+        time.sleep(15)
+    httpd.shutdown()
+    httpd.server_close()
+    dash.stop()
+    return sink
+
+
+if __name__ == "__main__":
+    main()
