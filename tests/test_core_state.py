@@ -130,3 +130,33 @@ class TestContextMeta:
         assert default_hash(42) == 42
         assert default_hash("abc") == default_hash("abc")
         assert default_hash("abc") != default_hash("abd")
+
+
+def test_tuple_batch_take_edge_cases():
+    """take() fast path keeps numpy-indexing semantics: row selection on
+    n-dim payload columns, loud wrong-length masks, empty index lists."""
+    import numpy as np
+    import pytest
+    from windflow_tpu.core.tuples import TupleBatch
+
+    n = 4
+    tb = TupleBatch({
+        "key": np.arange(n), "id": np.arange(n), "ts": np.arange(n),
+        "value": np.arange(n, dtype=np.float64),
+        "emb": np.arange(n * 3, dtype=np.float64).reshape(n, 3),
+    })
+    # boolean mask selects ROWS of 2-D payloads
+    out = tb.take(np.array([True, False, True, False]))
+    np.testing.assert_array_equal(out["emb"], tb["emb"][[0, 2]])
+    # integer indices too
+    out2 = tb.take(np.array([3, 1]))
+    np.testing.assert_array_equal(out2["emb"], tb["emb"][[3, 1]])
+    np.testing.assert_array_equal(out2.key, [3, 1])
+    # wrong-length mask fails loudly, as plain numpy indexing does
+    with pytest.raises(IndexError, match="mask length"):
+        tb.take(np.array([True, False]))
+    # empty Python list -> empty batch
+    assert len(tb.take([])) == 0
+    # slice stays a view (zero-copy lane)
+    sl = tb.take(slice(1, 3))
+    assert sl["value"].base is tb["value"]
