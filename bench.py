@@ -217,7 +217,7 @@ def run_pane_farm_tpu(n_events):
     g = wf.PipeGraph("bench3", wf.Mode.DEFAULT)
     op = PaneFarmTPU("sum", wlq, WIN, SLIDE, wf.WinType.TB,
                      plq_parallelism=1, wlq_parallelism=1,
-                     batch_len=DEVICE_BATCH)
+                     batch_len=DEVICE_BATCH, max_buffer_elems=MAX_BUFFER)
     g.add_source(BatchSource(_template_source(n_events, {}),
                              SOURCE_PARALLELISM)) \
         .add(op).add_sink(Sink(sink))

@@ -747,3 +747,51 @@ def test_pallas_winsum_engine_path(monkeypatch):
                       np.arange(16)).block()
     expect = [vals[s:e].sum() for s, e in zip(starts, ends)]
     np.testing.assert_allclose(out, expect, rtol=1e-3)
+
+
+def test_with_max_buffer_builder_knob():
+    """withMaxBuffer reaches every device-engine replica, including the
+    PLQ replicas of nested Pane_Farm copies."""
+    import windflow_tpu as wf
+    from windflow_tpu.core import WinType
+
+    op = wf.PaneFarmTPUBuilder("sum", lambda g, it, r: None) \
+        .with_parallelism(2, 1).withTBWindows(64, 4) \
+        .withMaxBuffer(1 << 20).build()
+    assert op.max_buffer_elems == 1 << 20
+    for st in op.stages():
+        for rep in st.replicas:
+            if hasattr(rep, "max_buffer_elems"):
+                assert rep.max_buffer_elems == 1 << 20
+    nested = wf.WinFarmTPUBuilder(
+        wf.PaneFarmTPUBuilder("sum", lambda g, it, r: None)
+        .with_parallelism(1, 1).withTBWindows(64, 4)
+        .withMaxBuffer(1 << 20).build()).with_parallelism(2).build()
+    for st in nested.stages():
+        for rep in st.replicas:
+            if hasattr(rep, "max_buffer_elems"):
+                assert rep.max_buffer_elems == 1 << 20
+    seq = wf.WinSeqTPUBuilder("sum").withCBWindows(64, 16) \
+        .with_max_buffer(123456).build()
+    assert seq.kwargs["max_buffer_elems"] == 123456
+    # ... and on every other TPU builder, including WLQ-on-device
+    others = [
+        wf.WinFarmTPUBuilder("sum").withTBWindows(64, 4)
+            .withParallelism(3),
+        wf.WinMapReduceTPUBuilder("sum", lambda g, it, r: None)
+            .withTBWindows(64, 4).withParallelism(2, 1),
+        wf.WinSeqFFATTPUBuilder(lambda t, r: None, "sum")
+            .withTBWindows(64, 4),
+        wf.KeyFFATTPUBuilder(lambda t, r: None, "sum")
+            .withTBWindows(64, 4).withParallelism(2),
+        wf.PaneFarmTPUBuilder("sum", lambda g, it, r: None,
+                              plq_on_tpu=False)
+            .withTBWindows(64, 4).withParallelism(1, 1),
+    ]
+    for b in others:
+        op2 = b.withMaxBuffer(1 << 20).build()
+        carriers = [rep for st in op2.stages() for rep in st.replicas
+                    if hasattr(rep, "max_buffer_elems")]
+        assert carriers, type(op2).__name__
+        assert all(r.max_buffer_elems == 1 << 20 for r in carriers), \
+            type(op2).__name__

@@ -16,13 +16,23 @@ from ..core.basic import OptLevel, WinType
 from ..operators.tpu.farms_tpu import (KeyFarmTPU, KeyFFATTPU, PaneFarmTPU,
                                        WinFarmTPU, WinMapReduceTPU,
                                        WinSeqFFATTPU)
-from ..operators.tpu.win_seq_tpu import DEFAULT_BATCH_LEN, WinSeqTPU
+from ..operators.tpu.win_seq_tpu import (DEFAULT_BATCH_LEN,
+    DEFAULT_MAX_BUFFER_ELEMS, WinSeqTPU)
 from .builders import _BuilderBase, _WinBuilderBase, _alias_camel
 
 
 class _TPUBuilderMixin:
+    max_buffer_elems = DEFAULT_MAX_BUFFER_ELEMS
+
     def with_batch(self, batch_len: int):
         self.batch_len = batch_len
+        return self
+
+    def with_max_buffer(self, elems: int):
+        """Host staging-buffer capacity (elements) for the device
+        engine replicas; larger buffers flush less often on the hot
+        ingest path."""
+        self.max_buffer_elems = elems
         return self
 
     def with_tpu_configuration(self, device_index: int = 0):
@@ -64,7 +74,8 @@ class WinSeqTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                          self.win_type, self.batch_len,
                          self.triggering_delay, self.name,
                          self.result_factory, self.value_of,
-                         self.closing_func, self.emit_batches)
+                         self.closing_func, self.emit_batches,
+                         max_buffer_elems=self.max_buffer_elems)
 
 
 @_alias_camel
@@ -96,7 +107,8 @@ class WinFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                           self.win_type, self.parallelism, self.batch_len,
                           self.triggering_delay, self.name,
                           self.result_factory, self.value_of, self.ordered,
-                          self.opt_level)
+                          self.opt_level,
+                          max_buffer_elems=self.max_buffer_elems)
 
 
 @_alias_camel
@@ -123,7 +135,8 @@ class KeyFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                           self.win_type, self.parallelism, self.batch_len,
                           self.triggering_delay, self.name,
                           self.result_factory, self.value_of,
-                          emit_batches=self.emit_batches)
+                          emit_batches=self.emit_batches,
+                          max_buffer_elems=self.max_buffer_elems)
 
 
 @_alias_camel
@@ -157,7 +170,8 @@ class PaneFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                            self.plq_on_tpu, not self.plq_on_tpu,
                            self.batch_len, self.triggering_delay, self.name,
                            self.result_factory, self.value_of, self.ordered,
-                           self.opt_level)
+                           self.opt_level,
+                           max_buffer_elems=self.max_buffer_elems)
 
 
 @_alias_camel
@@ -191,7 +205,8 @@ class WinMapReduceTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                                self.par2, self.map_on_tpu, self.batch_len,
                                self.triggering_delay, self.name,
                                self.result_factory, self.value_of,
-                               self.ordered)
+                               self.ordered,
+                               max_buffer_elems=self.max_buffer_elems)
 
 
 @_alias_camel
@@ -247,7 +262,8 @@ class WinSeqFFATTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
         return WinSeqFFATTPU(self.fn, self.combine, self.win_len,
                              self.slide_len, self.win_type, self.batch_len,
                              self.triggering_delay, self.name,
-                             self.result_factory)
+                             self.result_factory,
+                             max_buffer_elems=self.max_buffer_elems)
 
 
 @_alias_camel
@@ -267,4 +283,5 @@ class KeyFFATTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
         return KeyFFATTPU(self.fn, self.combine, self.win_len,
                           self.slide_len, self.win_type, self.parallelism,
                           self.batch_len, self.triggering_delay, self.name,
-                          self.result_factory)
+                          self.result_factory,
+                          max_buffer_elems=self.max_buffer_elems)

@@ -68,6 +68,7 @@ def _clone_inner(inner: InnerOp, idx: int, n_replicas: int,
             inner.win_type, inner.plq_par, inner.wlq_par,
             plq_on_tpu=inner.plq_on_tpu, wlq_on_tpu=not inner.plq_on_tpu,
             batch_len=inner.batch_len,
+            max_buffer_elems=inner.max_buffer_elems,
             triggering_delay=inner.triggering_delay,
             name=f"{inner.name}_{idx}", result_factory=inner.result_factory,
             value_of=inner.value_of, ordered=False,

@@ -33,14 +33,15 @@ from ...runtime.win_routing import KFEmitter, WFEmitter, WidOrderCollector, \
     WinMapEmitter
 from ..base import Operator, StageSpec
 from ..win_seq import WinSeqLogic
-from .win_seq_tpu import DEFAULT_BATCH_LEN, WinSeqTPULogic
+from .win_seq_tpu import (DEFAULT_BATCH_LEN,
+                          DEFAULT_MAX_BUFFER_ELEMS, WinSeqTPULogic)
 
 
 def _tpu_replicas(win_kind, win_len, slide_len, win_type, par, *,
                   batch_len, triggering_delay, result_factory, value_of,
                   enclosing: WinOperatorConfig, role: Role,
                   farm_kind: str, renumbering=False, emit_batches=False,
-                  max_buffer_elems=1 << 19):
+                  max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS):
     """Build the worker set with the same config conventions as the CPU
     farms (win_farm.hpp:175 / key_farm worker configs)."""
     reps = []
@@ -88,7 +89,7 @@ class KeyFarmTPU(_TPUWinOp):
                  triggering_delay=0, name="key_farm_tpu",
                  result_factory=BasicRecord, value_of=None,
                  config: WinOperatorConfig = None, emit_batches=False,
-                 max_buffer_elems=1 << 19):
+                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS):
         super().__init__(name, parallelism, RoutingMode.KEYBY,
                          Pattern.KEY_FARM_TPU, win_type)
         self.args = (win_kind, win_len, slide_len, win_type)
@@ -119,9 +120,11 @@ class WinFarmTPU(_TPUWinOp):
                  triggering_delay=0, name="win_farm_tpu",
                  result_factory=BasicRecord, value_of=None, ordered=True,
                  opt_level=OptLevel.LEVEL0,
-                 config: WinOperatorConfig = None, role: Role = Role.SEQ):
+                 config: WinOperatorConfig = None, role: Role = Role.SEQ,
+                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS):
         super().__init__(name, parallelism, RoutingMode.COMPLEX,
                          Pattern.WIN_FARM_TPU, win_type)
+        self.max_buffer_elems = max_buffer_elems
         self.args = (win_kind, win_len, slide_len, win_type)
         self.batch_len = batch_len
         self.triggering_delay = triggering_delay
@@ -139,7 +142,8 @@ class WinFarmTPU(_TPUWinOp):
             kind, win_len, slide_len, win_type, self.parallelism,
             batch_len=self.batch_len, triggering_delay=self.triggering_delay,
             result_factory=self.result_factory, value_of=self.value_of,
-            enclosing=cfg, role=self.role, farm_kind="wf")
+            enclosing=cfg, role=self.role, farm_kind="wf",
+            max_buffer_elems=self.max_buffer_elems)
         emitter = WFEmitter(win_len, slide_len, self.parallelism, win_type,
                             self.role, id_outer=cfg.id_inner,
                             n_outer=cfg.n_inner, slide_outer=cfg.slide_inner)
@@ -161,7 +165,8 @@ class PaneFarmTPU(_TPUWinOp):
                  triggering_delay=0, name="pane_farm_tpu",
                  result_factory=BasicRecord, value_of=None, ordered=True,
                  opt_level=OptLevel.LEVEL0,
-                 config: WinOperatorConfig = None):
+                 config: WinOperatorConfig = None,
+                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS):
         super().__init__(name, plq_parallelism + wlq_parallelism,
                          RoutingMode.COMPLEX, Pattern.PANE_FARM_TPU,
                          win_type)
@@ -191,6 +196,7 @@ class PaneFarmTPU(_TPUWinOp):
         self.ordered = ordered
         self.opt_level = opt_level
         self.pane_len = pane_length(win_len, slide_len)
+        self.max_buffer_elems = max_buffer_elems
         # enclosing config: identity standalone, nested arithmetic when
         # replicated inside a Win_Farm/Key_Farm (win_farm_gpu.hpp:73-76)
         self.config = config or WinOperatorConfig(0, 1, slide_len,
@@ -203,7 +209,8 @@ class PaneFarmTPU(_TPUWinOp):
             kind, win, slide, win_type, 1, batch_len=self.batch_len,
             triggering_delay=delay, result_factory=self.result_factory,
             value_of=self.value_of, enclosing=self.config, role=role,
-            farm_kind="seq")[0]
+            farm_kind="seq",
+            max_buffer_elems=self.max_buffer_elems)[0]
 
     def _host_single(self, fn, win, slide, win_type, role, delay=0):
         cfg = self.config
@@ -255,7 +262,8 @@ class PaneFarmTPU(_TPUWinOp):
                 triggering_delay=self.triggering_delay,
                 result_factory=self.result_factory, value_of=self.value_of,
                 enclosing=cfg, role=Role.PLQ,
-                farm_kind="wf" if self.plq_par > 1 else "seq")
+                farm_kind="wf" if self.plq_par > 1 else "seq",
+                max_buffer_elems=self.max_buffer_elems)
             # the enclosing offsets shift pane membership when this
             # operator is a nested copy (the configSeq construction,
             # win_farm.hpp:175; emitter without them routes panes
@@ -286,7 +294,8 @@ class PaneFarmTPU(_TPUWinOp):
                 batch_len=self.batch_len, triggering_delay=0,
                 result_factory=self.result_factory, value_of=self.value_of,
                 enclosing=cfg, role=Role.WLQ,
-                farm_kind="wf" if self.wlq_par > 1 else "seq")
+                farm_kind="wf" if self.wlq_par > 1 else "seq",
+                max_buffer_elems=self.max_buffer_elems)
             emitter = (WFEmitter(wlq_win, wlq_slide, self.wlq_par,
                                  WinType.CB, Role.WLQ,
                                  id_outer=cfg.id_inner, n_outer=cfg.n_inner,
@@ -328,7 +337,8 @@ class WinMapReduceTPU(_TPUWinOp):
                  map_on_tpu=True, batch_len=DEFAULT_BATCH_LEN,
                  triggering_delay=0, name="win_mr_tpu",
                  result_factory=BasicRecord, value_of=None, ordered=True,
-                 config: WinOperatorConfig = None):
+                 config: WinOperatorConfig = None,
+                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS):
         super().__init__(name, map_parallelism + reduce_parallelism,
                          RoutingMode.COMPLEX, Pattern.WIN_MAPREDUCE_TPU,
                          win_type)
@@ -344,6 +354,7 @@ class WinMapReduceTPU(_TPUWinOp):
         self.result_factory = result_factory
         self.value_of = value_of
         self.ordered = ordered
+        self.max_buffer_elems = max_buffer_elems
         self.config = config or WinOperatorConfig(0, 1, slide_len,
                                                   0, 1, slide_len)
 
@@ -364,7 +375,8 @@ class WinMapReduceTPU(_TPUWinOp):
                                              cfg.slide_inner, 0, 1,
                                              self.slide_len),
                     role=Role.MAP, map_indexes=(i, mp), parallelism=mp,
-                    replica_index=i, value_of=self.value_of))
+                    replica_index=i, value_of=self.value_of,
+                    max_buffer_elems=self.max_buffer_elems))
         else:
             reps = [WinSeqLogic(
                 self.map_stage, self.win_len, self.slide_len, self.win_type,
@@ -392,7 +404,8 @@ class WinMapReduceTPU(_TPUWinOp):
                 self.reduce_stage, mp, mp, WinType.CB, 1,
                 batch_len=self.batch_len, triggering_delay=0,
                 result_factory=self.result_factory, value_of=self.value_of,
-                enclosing=cfg, role=Role.REDUCE, farm_kind="seq")
+                enclosing=cfg, role=Role.REDUCE, farm_kind="seq",
+                max_buffer_elems=self.max_buffer_elems)
         stages.append(StageSpec(
             f"{self.name}_reduce", logic, StandardEmitter(keyed=True),
             RoutingMode.KEYBY, ordering_mode=OrderingMode.ID))
@@ -416,11 +429,13 @@ class WinSeqFFATTPU(_TPUWinOp):
 
     def __init__(self, lift: Callable, combine: Any, win_len, slide_len,
                  win_type, batch_len=DEFAULT_BATCH_LEN, triggering_delay=0,
-                 name="win_seqffat_tpu", result_factory=BasicRecord):
+                 name="win_seqffat_tpu", result_factory=BasicRecord,
+                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS):
         super().__init__(name, 1, RoutingMode.FORWARD,
                          Pattern.WIN_SEQFFAT_TPU, win_type)
         self.kind = _ffat_kind(combine)
         self.lift = lift
+        self.max_buffer_elems = max_buffer_elems
         self.args = (win_len, slide_len, win_type, batch_len,
                      triggering_delay, result_factory)
 
@@ -429,7 +444,8 @@ class WinSeqFFATTPU(_TPUWinOp):
         logic = WinSeqTPULogic(
             self.kind, win_len, slide_len, win_type, batch_len=batch_len,
             triggering_delay=delay, result_factory=rf, value_of=self.lift,
-            renumbering=self._renumbering)
+            renumbering=self._renumbering,
+            max_buffer_elems=self.max_buffer_elems)
         return [StageSpec(self.name, [logic], StandardEmitter(),
                           self.routing, ordering_mode=self._ordering())]
 
@@ -440,11 +456,13 @@ class KeyFFATTPU(_TPUWinOp):
     def __init__(self, lift: Callable, combine: Any, win_len, slide_len,
                  win_type, parallelism=1, batch_len=DEFAULT_BATCH_LEN,
                  triggering_delay=0, name="key_ffat_tpu",
-                 result_factory=BasicRecord):
+                 result_factory=BasicRecord,
+                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS):
         super().__init__(name, parallelism, RoutingMode.KEYBY,
                          Pattern.KEY_FFAT_TPU, win_type)
         self.kind = _ffat_kind(combine)
         self.lift = lift
+        self.max_buffer_elems = max_buffer_elems
         self.args = (win_len, slide_len, win_type, batch_len,
                      triggering_delay, result_factory)
 
@@ -455,7 +473,8 @@ class KeyFFATTPU(_TPUWinOp):
             triggering_delay=delay, result_factory=rf, value_of=self.lift,
             config=WinOperatorConfig(0, 1, 0, 0, 1, slide_len),
             parallelism=self.parallelism, replica_index=i,
-            renumbering=self._renumbering)
+            renumbering=self._renumbering,
+            max_buffer_elems=self.max_buffer_elems)
             for i in range(self.parallelism)]
         return [StageSpec(self.name, reps, KFEmitter(self.parallelism),
                           self.routing, ordering_mode=self._ordering())]

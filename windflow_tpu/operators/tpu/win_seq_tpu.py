@@ -37,6 +37,8 @@ from ...runtime.node import EOSMarker, NodeLogic
 from ..base import Operator, StageSpec
 
 DEFAULT_BATCH_LEN = 256
+# host staging-buffer capacity (elements) before a forced flush
+DEFAULT_MAX_BUFFER_ELEMS = 1 << 19
 
 
 def _key_groups(keys: np.ndarray):
@@ -200,7 +202,7 @@ class WinSeqTPULogic(NodeLogic):
                  replica_index: int = 0, renumbering: bool = False,
                  value_of: Callable[[Any], float] = None,
                  closing_func: Callable = None, emit_batches: bool = False,
-                 max_buffer_elems: int = 1 << 19, inflight_depth: int = 4,
+                 max_buffer_elems: int = DEFAULT_MAX_BUFFER_ELEMS, inflight_depth: int = 4,
                  async_dispatch: bool = True,
                  max_batch_delay_ms: float = 10.0):
         if win_len == 0 or slide_len == 0:
@@ -869,7 +871,7 @@ class WinSeqTPU(Operator):
                  batch_len=DEFAULT_BATCH_LEN, triggering_delay=0,
                  name="win_seq_tpu", result_factory=BasicRecord,
                  value_of=None, closing_func=None, emit_batches=False,
-                 max_buffer_elems=1 << 19, inflight_depth=4,
+                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS, inflight_depth=4,
                  async_dispatch=True, max_batch_delay_ms=10.0):
         super().__init__(name, 1, RoutingMode.FORWARD, Pattern.WIN_SEQ_TPU)
         self.win_type = win_type
