@@ -217,3 +217,94 @@ def test_dashboard_http_webui(tmp_path):
         httpd.shutdown()
         httpd.server_close()
         dash.stop()
+
+
+def test_webui_script_structure():
+    """No JS engine exists in this environment, so structurally lint the
+    dashboard page's embedded script: balanced brackets outside
+    strings/templates/regex-free zones, terminated string literals, and
+    resolved Python-level escapes. Catches the realistic breakages
+    (unbalanced template literals, bad escaping) that the marker-grep
+    test cannot."""
+    import re
+
+    from windflow_tpu.monitoring.webui import HTML_PAGE
+
+    m = re.search(r"<script>\n(.*?)</script>", HTML_PAGE, re.S)
+    assert m, "no script block"
+    src = m.group(1)
+    # Python-level escapes must have resolved: the page is a plain
+    # string, so a literal backslash-backslash means a \\ reached JS
+    assert "\\\\" not in src.replace("\\\\n", "").replace(
+        "\\\\s", "").replace("\\\\w", "").replace("\\\\[", ""), \
+        "unresolved double backslash outside regex"
+    stack = []
+    pairs = {")": "(", "]": "[", "}": "{"}
+    i, n, state = 0, len(src), None  # state: None | '"' | "'" | "`"
+    while i < n:
+        c = src[i]
+        if state is None:
+            if c == "/" and i + 1 < n and src[i + 1] == "/":
+                i = src.find("\n", i)
+                i = n if i < 0 else i
+                continue
+            if c == "/" and i + 1 < n and src[i + 1] == "*":
+                end = src.find("*/", i + 2)
+                assert end >= 0, f"unterminated block comment at {i}"
+                i = end + 2
+                continue
+            if c == "/":
+                # regex literal iff it can't be division: previous
+                # non-space token is an operator/open-bracket/keyword
+                j = i - 1
+                while j >= 0 and src[j] in " \t\n":
+                    j -= 1
+                word = re.search(r"[A-Za-z$_]+$", src[:j + 1])
+                if (j < 0 or src[j] in "(,=:[!&|?{;"
+                        or (src[j] == ">" and j > 0 and src[j-1] == "=")
+                        or (word and word.group(0) in (
+                            "return", "typeof", "case", "in", "of",
+                            "new", "delete", "void", "instanceof"))):
+                    in_class = False
+                    i += 1
+                    while i < n:
+                        if src[i] == "\\":
+                            i += 2
+                            continue
+                        if src[i] == "[":
+                            in_class = True
+                        elif src[i] == "]":
+                            in_class = False
+                        elif src[i] == "/" and not in_class:
+                            break
+                        i += 1
+                    i += 1
+                    continue
+            if c == "}" and stack and stack[-1][0] == "${":
+                stack.pop()          # end of template interpolation
+                state = "`"
+            elif c in "\"'`":
+                state = c
+            elif c in "([{":
+                stack.append((c, i))
+            elif c in ")]}":
+                assert stack and stack[-1][0] == pairs[c], \
+                    f"unbalanced {c!r} at offset {i}: {src[max(0,i-40):i+5]!r}"
+                stack.pop()
+        else:
+            if c == "\\":
+                i += 2
+                continue
+            assert not (c == "\n" and state in "\"'"), \
+                f"unterminated {state} string literal before offset {i}"
+            if state == "`" and c == "$" and i + 1 < n and src[i+1] == "{":
+                # template interpolation: recurse-lite via the stack
+                stack.append(("${", i))
+                state = None
+                i += 2
+                continue
+            if c == state:
+                state = None
+        i += 1
+    assert state is None, f"unterminated {state} literal"
+    assert not stack, f"unclosed {stack[-3:]}"
