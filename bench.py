@@ -313,7 +313,7 @@ def main():
               "backend", file=sys.stderr)
         backend = "cpu-fallback"
         note = ("TPU transport unreachable at bench time; last measured "
-                "TPU headline 56.1M tuples/s = 1.85x baseline, p99 157ms "
+                "TPU headline 58.4M tuples/s = 1.99x baseline, p99 143ms "
                 "(BASELINE.md r4 measured table)")
         import jax
         jax.config.update("jax_platforms", "cpu")
