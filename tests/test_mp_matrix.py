@@ -203,6 +203,20 @@ def test_string_keys(kind):
         assert sink.total == expected_sum_of_events(src.events, WIN, SLIDE)
 
 
+def collect_dropped(g):
+    """Dropped-record control fields from every K-slack collector,
+    split into the two independent drop planes: window-stage collectors
+    drop late SOURCE tuples; the sink collector drops late window
+    RESULTS (cross-replica result disorder)."""
+    dropped_src, dropped_res = [], []
+    for node in g._all_nodes():
+        dr = getattr(node.logic, "dropped_records", None)
+        if dr is None:
+            continue
+        (dropped_res if "sink" in node.name else dropped_src).extend(dr)
+    return dropped_src, dropped_res
+
+
 def test_probabilistic_mode_out_of_order():
     """_prob variants: K-slack collectors on an out-of-order stream.
     Exact accounting oracle: every source tuple is either emitted
@@ -219,16 +233,7 @@ def test_probabilistic_mode_out_of_order():
         .add(op).add_sink(wf.SinkBuilder(sink).build())
     g.run()
     assert sink.count > 0
-    # two K-slack planes drop independently: the window collectors drop
-    # late SOURCE tuples; the sink collector drops late window RESULTS
-    # (cross-replica result disorder) -- both identified by control
-    # fields
-    dropped_src, dropped_res = [], []
-    for node in g._all_nodes():
-        dr = getattr(node.logic, "dropped_records", None)
-        if dr is None:
-            continue
-        (dropped_res if "sink" in node.name else dropped_src).extend(dr)
+    dropped_src, dropped_res = collect_dropped(g)
     assert g.get_num_dropped_tuples() == len(dropped_src) + len(dropped_res)
     dropped_ids = {(k, tid) for k, tid, _ts in dropped_src}
     assert len(dropped_ids) == len(dropped_src)  # no tuple dropped twice
@@ -472,12 +477,7 @@ def test_columnar_plane_ordering_modes(mode):
     # PROBABILISTIC is lossy until K adapts to the cross-replica skew:
     # exact accounting instead (every tuple either contributes or is in
     # a collector's dropped_records; same for window-result batches)
-    dropped_src, dropped_res = [], []
-    for node in g._all_nodes():
-        dr = getattr(node.logic, "dropped_records", None)
-        if dr is None:
-            continue
-        (dropped_res if "sink" in node.name else dropped_src).extend(dr)
+    dropped_src, dropped_res = collect_dropped(g)
     assert g.get_num_dropped_tuples() == len(dropped_src) + len(dropped_res)
     dropped_ids = {(k, t) for k, t, _ in dropped_src}
     events = [(i % NK, i // NK, i // NK) for i in range(N)]
@@ -522,3 +522,39 @@ def test_eos_markers_are_plane_neutral():
                               "value": np.ones(1)}), 0, lambda x: None)
         logic.svc(EOSMarker(BasicRecord(0, 5, 5, 0.0)), 0,
                   lambda x: None)  # must not raise
+
+
+def test_kslack_adaptive_k_converges():
+    """K-slack drop-rate characterization (advisor r3 follow-up):
+    SOURCE-plane drops are deterministic (one source thread, fixed
+    partition), and with bounded disorder the adaptive K = max observed
+    delay covers the jitter after a warm-up prefix -- so source drops
+    stay under 2% and none occur in the stream's second half
+    (kslack_node.hpp:93-139 adaptation, :193-200 drop rule).
+
+    The RESULT plane (sink collector) is deliberately NOT bounded here:
+    its disorder is cross-replica scheduling skew, which varies run to
+    run (observed 3-255 dropped results for this same config), so the
+    only stable claim is exact accounting -- every drop is recorded and
+    the graph counter matches."""
+    per_key, n_keys = 600, 4
+    sink = SumSink()
+    g = wf.PipeGraph("kconv", Mode.PROBABILISTIC)
+    src = pareto_ooo_stream(n_keys, per_key, jitter=6, seed=3)
+    op = build_window_op("kf", WinType.TB, 3, random.Random(2))
+    g.add_source(wf.SourceBuilder(src).build()) \
+        .add(op).add_sink(wf.SinkBuilder(sink).build())
+    g.run()
+
+    dropped_src, dropped_res = collect_dropped(g)
+    assert g.get_num_dropped_tuples() == len(dropped_src) + len(dropped_res)
+    n_events = len(src.events)
+    assert sink.count > 0
+    # source drop fraction is small...
+    assert len(dropped_src) <= 0.02 * n_events, (
+        len(dropped_src), n_events)
+    # ...and K has converged: nothing from the stream's second half
+    # (by per-key tuple index) is dropped
+    half = per_key // 2
+    late_drops = [(k, tid) for k, tid, _ts in dropped_src if tid >= half]
+    assert not late_drops, late_drops

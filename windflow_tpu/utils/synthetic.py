@@ -46,10 +46,13 @@ def pareto_ooo_stream(n_keys: int, per_key: int, seed: int = 0,
     ``_string`` test variants)."""
     rnd = random.Random(seed)
     ts = {k: 0 for k in range(n_keys)}
-    emitted = {k: 0 for k in range(n_keys)}
+    # round-robin across keys (NOT key-segment concatenation: that
+    # would reset the merged timeline to ~0 at every key boundary,
+    # giving unbounded lateness instead of the documented
+    # jitter-bounded disorder)
     buffer = []
-    for k in range(n_keys):
-        for i in range(per_key):
+    for i in range(per_key):
+        for k in range(n_keys):
             ts[k] += max(1, int(rnd.paretovariate(alpha)))
             buffer.append((k, i, ts[k]))
     # bounded shuffle: swap within windows of `jitter`
