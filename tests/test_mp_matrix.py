@@ -203,6 +203,32 @@ def test_string_keys(kind):
         assert sink.total == expected_sum_of_events(src.events, WIN, SLIDE)
 
 
+def interleaved_batch_source(N, BS, NK, value_fn, stride=2):
+    """Batch-source body where replica r emits every ``stride``-th
+    batch of a shared [0, N) timeline (round-robin keys, dense per-key
+    ids) -- the columnar-plane fixture shared by the ordering-mode and
+    soak tests."""
+    import numpy as np
+    from windflow_tpu.core.tuples import TupleBatch
+
+    state = {}
+
+    def source(ctx):
+        ridx = ctx.get_replica_index()
+        st = state.setdefault(ridx, {"b": ridx})
+        base = st["b"] * BS
+        if base >= N:
+            return None
+        n = min(BS, N - base)
+        idx = base + np.arange(n)
+        st["b"] += stride
+        ids = idx // NK
+        return TupleBatch({"key": idx % NK, "id": ids, "ts": ids,
+                           "value": value_fn(ids)})
+
+    return source
+
+
 def collect_dropped(g):
     """Dropped-record control fields from every K-slack collector,
     split into the two independent drop planes: window-stage collectors
@@ -425,20 +451,8 @@ def test_columnar_plane_ordering_modes(mode):
     from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
 
     N, BS, NK, WINL, SL = 40_000, 2048, 4, 100, 50
-    state = {}
-
-    def source(ctx):
-        ridx = ctx.get_replica_index()
-        st = state.setdefault(ridx, {"b": ridx})
-        base = st["b"] * BS
-        if base >= N:
-            return None
-        n = min(BS, N - base)
-        idx = base + np.arange(n)
-        st["b"] += 2
-        return TupleBatch({"key": idx % NK, "id": idx // NK,
-                           "ts": idx // NK,
-                           "value": (idx // NK).astype(np.float64)})
+    source = interleaved_batch_source(
+        N, BS, NK, lambda ids: ids.astype(np.float64), stride=2)
 
     got = {}
     lock = threading.Lock()
@@ -558,3 +572,50 @@ def test_kslack_adaptive_k_converges():
     half = per_key // 2
     late_drops = [(k, tid) for k, tid, _ts in dropped_src if tid >= half]
     assert not late_drops, late_drops
+
+
+def test_columnar_plane_soak_deterministic():
+    """Scale soak for the columnar DETERMINISTIC plane: 2M events from
+    two interleaved batch sources through the device window engine,
+    exact per-window oracle. Catches watermark/merge bugs that only
+    appear past many drain cycles and archive-purge boundaries (the
+    40k-event test above cannot)."""
+    import numpy as np
+    from windflow_tpu.core.tuples import TupleBatch
+    from windflow_tpu.operators.basic_ops import Sink
+    from windflow_tpu.operators.batch_ops import BatchSource
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
+
+    N, BS, NK, WINL, SL = 2_000_000, 65_536, 16, 1024, 512
+    source = interleaved_batch_source(
+        N, BS, NK, lambda ids: np.ones(len(ids), np.float32), stride=2)
+
+    tot = {"windows": 0, "sum": 0.0}
+    lock = threading.Lock()
+
+    def sink(item):
+        if item is None:
+            return
+        with lock:
+            if isinstance(item, TupleBatch):
+                tot["windows"] += len(item)
+                tot["sum"] += float(item["value"].sum())
+            else:
+                tot["windows"] += 1
+                tot["sum"] += item.value
+
+    g = wf.PipeGraph("soak", Mode.DETERMINISTIC)
+    op = WinSeqTPU("sum", WINL, SL, WinType.TB, batch_len=4096,
+                   emit_batches=True)
+    g.add_source(BatchSource(source, 2)).add(op).add_sink(Sink(sink))
+    g.run()
+
+    per_key = N // NK
+    exp_windows, exp_sum, w = 0, 0, 0
+    while w * SL < per_key:
+        exp_windows += 1
+        exp_sum += min(per_key, w * SL + WINL) - w * SL
+        w += 1
+    assert tot["windows"] == exp_windows * NK, (tot["windows"],
+                                                exp_windows * NK)
+    assert tot["sum"] == float(exp_sum * NK), (tot["sum"], exp_sum * NK)
