@@ -57,8 +57,9 @@ def prefix_ops(rnd):
             wf.MapBuilder(ident).with_parallelism(rnd.randint(1, 3)).build())
 
 
-def build_window_op(kind, win_type, par, rnd, win=None):
+def build_window_op(kind, win_type, par, rnd, win=None, slide=None):
     win = WIN if win is None else win
+    slide = SLIDE if slide is None else slide
     if kind == "wf":
         b = wf.WinFarmBuilder(sum_win).with_parallelism(par)
     elif kind == "kf":
@@ -76,49 +77,50 @@ def build_window_op(kind, win_type, par, rnd, win=None):
             .with_parallelism(max(2, par), 1)
     elif kind == "kf+pf":
         inner = wf.PaneFarmBuilder(sum_win, sum_win).with_parallelism(2, 1) \
-            .with_tb_windows(win, SLIDE).build() if win_type == WinType.TB \
+            .with_tb_windows(win, slide).build() if win_type == WinType.TB \
             else wf.PaneFarmBuilder(sum_win, sum_win).with_parallelism(2, 1) \
-            .with_cb_windows(win, SLIDE).build()
+            .with_cb_windows(win, slide).build()
         return wf.KeyFarmBuilder(inner).with_parallelism(par).build()
     elif kind == "wf+pf":
         inner = _with_wins(wf.PaneFarmBuilder(sum_win, sum_win)
-                           .with_parallelism(2, 1), win_type, win).build()
+                           .with_parallelism(2, 1), win_type, win, slide).build()
         return wf.WinFarmBuilder(inner).with_parallelism(par).build()
     elif kind == "wf+wmr":
         inner = _with_wins(wf.WinMapReduceBuilder(sum_win, sum_win)
-                           .with_parallelism(2, 1), win_type, win).build()
+                           .with_parallelism(2, 1), win_type, win, slide).build()
         return wf.WinFarmBuilder(inner).with_parallelism(par).build()
     elif kind == "kf+wmr":
         inner = _with_wins(wf.WinMapReduceBuilder(sum_win, sum_win)
-                           .with_parallelism(2, 1), win_type, win).build()
+                           .with_parallelism(2, 1), win_type, win, slide).build()
         return wf.KeyFarmBuilder(inner).with_parallelism(par).build()
     # device-side complex nesting (win_farm_gpu.hpp:73-76,
     # key_farm_gpu.hpp:254): the inner device stage runs builtin 'sum'
     elif kind == "wf+pf_tpu":
         inner = _with_wins(wf.PaneFarmTPUBuilder("sum", sum_win)
-                           .with_parallelism(2, 1), win_type, win).build()
+                           .with_parallelism(2, 1), win_type, win, slide).build()
         return wf.WinFarmTPUBuilder(inner).with_parallelism(par).build()
     elif kind == "kf+pf_tpu":
         inner = _with_wins(wf.PaneFarmTPUBuilder("sum", sum_win)
-                           .with_parallelism(2, 1), win_type, win).build()
+                           .with_parallelism(2, 1), win_type, win, slide).build()
         return wf.KeyFarmTPUBuilder(inner).with_parallelism(par).build()
     elif kind == "wf+wmr_tpu":
         inner = _with_wins(wf.WinMapReduceTPUBuilder("sum", sum_win)
-                           .with_parallelism(2, 1), win_type, win).build()
+                           .with_parallelism(2, 1), win_type, win, slide).build()
         return wf.WinFarmTPUBuilder(inner).with_parallelism(par).build()
     elif kind == "kf+wmr_tpu":
         inner = _with_wins(wf.WinMapReduceTPUBuilder("sum", sum_win)
-                           .with_parallelism(2, 1), win_type, win).build()
+                           .with_parallelism(2, 1), win_type, win, slide).build()
         return wf.KeyFarmTPUBuilder(inner).with_parallelism(par).build()
     else:
         raise ValueError(kind)
-    return _with_wins(b, win_type, win).build()
+    return _with_wins(b, win_type, win, slide).build()
 
 
-def _with_wins(builder, win_type, win=None):
+def _with_wins(builder, win_type, win=None, slide=None):
     win = WIN if win is None else win
-    return (builder.with_tb_windows(win, SLIDE) if win_type == WinType.TB
-            else builder.with_cb_windows(win, SLIDE))
+    slide = SLIDE if slide is None else slide
+    return (builder.with_tb_windows(win, slide) if win_type == WinType.TB
+            else builder.with_cb_windows(win, slide))
 
 
 def expected_total(per_key, n_keys, win, slide):
@@ -619,3 +621,28 @@ def test_columnar_plane_soak_deterministic():
     assert tot["windows"] == exp_windows * NK, (tot["windows"],
                                                 exp_windows * NK)
     assert tot["sum"] == float(exp_sum * NK), (tot["sum"], exp_sum * NK)
+
+
+@pytest.mark.parametrize("kind", ["wf", "kf", "kff", "wmr"])
+@pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
+def test_hopping_windows_matrix(kind, win_type):
+    """Hopping windows (slide > win leave gaps, win_seq.hpp:388-411):
+    gap tuples belong to NO window on every engine -- including the
+    FFAT engine, whose pending buffer once leaked the previous
+    window's trigger tuple into the next window (the r4 hopping fix).
+    Pane_Farm kinds are excluded: pane decomposition is
+    sliding-windows-only and rejects win <= slide."""
+    win, slide, per_key = 4, 10, 200
+    totals = []
+    for par in (1, 3):
+        sink = SumSink()
+        g = wf.PipeGraph("hop", Mode.DETERMINISTIC)
+        op = build_window_op(kind, win_type, par, random.Random(par),
+                             win, slide)
+        g.add_source(wf.SourceBuilder(
+            ordered_keyed_stream(N_KEYS, per_key)).build()) \
+            .add(op).add_sink(wf.SinkBuilder(sink).build())
+        g.run()
+        totals.append(sink.total)
+    assert totals[0] == totals[1] == \
+        expected_total(per_key, N_KEYS, win, slide)

@@ -109,6 +109,15 @@ class WinSeqFFATLogic(NodeLogic):
         if n_evict:
             st.tree.remove(n_evict)
             del st.content_keys[:n_evict]
+        # hopping (win < slide): pending may hold gap tuples that
+        # arrived before this fire (e.g. the previous window's trigger
+        # tuple); they belong to NO window -- discard, never insert
+        # (win_seq.hpp:388-411 gap semantics)
+        gap = bisect.bisect_left(st.pending_keys, start)
+        if gap:
+            del st.pending_keys[:gap]
+            del st.pending_vals[:gap]
+            self.ignored_tuples += gap
         # insert pending values inside the window extent
         cut = bisect.bisect_left(st.pending_keys, end)
         if cut:
