@@ -189,15 +189,18 @@ def test_matrix_randomized_parallelism(kind, win_type):
         expected_total(per_key, N_KEYS, win, SLIDE)
 
 
-@pytest.mark.parametrize("kind", ["kf", "kff", "wf", "pf", "wmr"])
+@pytest.mark.parametrize("kind", ["kf", "kff", "wf", "pf", "wmr",
+                                  "kf_tpu", "kff_tpu"])
 def test_string_keys(kind):
     """_string variants: non-integral keys through hash routing, for
-    every window operator family (the reference's *_string tests).  CB
+    every window operator family incl. the device engines (the
+    reference's *_string tests; device record lanes intern non-integral
+    keys into a reserved id range and restore them on results).  CB
     kinds renumber arrival-dense ids in DEFAULT mode; the multicast
     kinds run TB windows over the stream's own timestamps."""
     sink = SumSink()
     g = wf.PipeGraph("mp", Mode.DEFAULT)
-    cb = kind in ("kf", "kff")
+    cb = kind in ("kf", "kff", "kf_tpu", "kff_tpu")
     src = pareto_ooo_stream(N_KEYS, PER_KEY, jitter=1, key_type="str")
     op = build_window_op(kind, WinType.CB if cb else WinType.TB, 3)
     g.add_source(wf.SourceBuilder(src).build()) \
@@ -685,3 +688,48 @@ def test_window_geometry_edges(kind, geometry):
         totals.append(sink.total)
     expect = expected_total(per_key, N_KEYS, win, slide)
     assert totals[0] == totals[1] == expect, (totals, expect)
+
+
+def test_string_keys_device_results_carry_original_keys():
+    """Interned device-plane keys are restored on emitted results (the
+    sink sees 'user_3', not the reserved internal id), and the intern
+    tables survive a state_dict round trip."""
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPULogic
+
+    seen = set()
+    lock = threading.Lock()
+
+    def sink(rec):
+        if rec is not None:
+            with lock:
+                seen.add(rec.key)
+
+    state = {"i": 0}
+
+    def src(shipper, ctx):
+        i = state["i"]
+        if i >= 400:
+            return False
+        shipper.push(BasicRecord(f"user_{i % 4}", i // 4, i // 4,
+                                 float(i)))
+        state["i"] = i + 1
+        return True
+
+    g = wf.PipeGraph("strdev", Mode.DEFAULT)
+    g.add_source(wf.SourceBuilder(src).build()) \
+        .add(wf.WinSeqTPUBuilder("sum").withCBWindows(20, 10).build()) \
+        .add_sink(wf.SinkBuilder(sink).build())
+    g.run()
+    assert seen == {f"user_{k}" for k in range(4)}, seen
+
+    logic = WinSeqTPULogic("sum", 20, 10, WinType.CB)
+    if logic._native is None:
+        pytest.skip("native engine unavailable: intern round-trip "
+                    "rides the native snapshot")
+    logic._intern_key("alpha")
+    logic._intern_key("beta")
+    st = logic.state_dict()
+    fresh = WinSeqTPULogic("sum", 20, 10, WinType.CB)
+    fresh.load_state(st)
+    assert fresh._key_intern == logic._key_intern
+    assert fresh._key_extern[logic._key_intern["beta"]] == "beta"

@@ -262,6 +262,11 @@ class WinSeqTPULogic(NodeLogic):
         # to the emit counter), applied on the flushed batch
         self._native = None
         self._plq_counters: Dict[Any, int] = {}
+        # non-integral record keys (the reference's templated key types)
+        # are interned into a reserved negative int64 range for the
+        # columnar/native stores and translated back on emission
+        self._key_intern: Dict[Any, int] = {}
+        self._key_extern: Dict[int, Any] = {}
         cfg = self.config
         if (isinstance(win_kind, str)
                 and win_kind in ("sum", "count", "max", "min", "mean")
@@ -401,26 +406,42 @@ class WinSeqTPULogic(NodeLogic):
             self._plq_counters[key] = start + (hi - lo)
         return out
 
+    def _intern_key(self, key) -> int:
+        iid = self._key_intern.get(key)
+        if iid is None:
+            iid = -(1 << 62) + len(self._key_intern)
+            self._key_intern[key] = iid
+            self._key_extern[iid] = key
+        return iid
+
     def _emit_results(self, results, descs, emit) -> None:
         if isinstance(descs, tuple) and descs[0] == "native":
             # native-engine batch: columnar descriptor arrays
             _, d_keys, d_gwids, d_rts = descs
             if self.role == Role.PLQ:
                 d_gwids = self._plq_renumber(d_keys)
-            if self.emit_batches:
+            if self.emit_batches and not self._key_extern:
                 emit(TupleBatch({"key": d_keys, "id": d_gwids,
                                  "ts": d_rts,
                                  "value": np.asarray(results, np.float64)}))
             else:
+                # per-record (also when interned keys must be restored:
+                # a TupleBatch key column cannot carry them)
+                ext = self._key_extern
                 for i in range(len(d_keys)):
                     out = self.result_factory()
                     out.value = float(results[i])
-                    out.set_control_fields(int(d_keys[i]), int(d_gwids[i]),
+                    k = int(d_keys[i])
+                    out.set_control_fields(ext.get(k, k), int(d_gwids[i]),
                                            int(d_rts[i]))
                     emit(out)
             return
-        if self.emit_batches and self.role == Role.SEQ:
+        if (self.emit_batches and self.role == Role.SEQ
+                and all(isinstance(d[0], (int, np.integer))
+                        for d in descs)):
             # columnar emission: one result TupleBatch per device batch
+            # (any non-integral key in the batch falls through to
+            # record emission below -- int and string keys can mix)
             out = TupleBatch({
                 "key": np.fromiter((d[0] for d in descs), np.int64,
                                    len(descs)),
@@ -705,6 +726,8 @@ class WinSeqTPULogic(NodeLogic):
             # route records through the native engine as 1-row columns so
             # mixed record/batch streams share one state store
             key, tid, ts = item.get_control_fields()
+            if not isinstance(key, (int, np.integer)):
+                key = self._intern_key(key)
             self._svc_batch_native(TupleBatch({
                 "key": np.array([key], np.int64),
                 "id": np.array([tid], np.int64),
@@ -825,6 +848,8 @@ class WinSeqTPULogic(NodeLogic):
         if self._native is not None:
             st["native"] = self._native.serialize()
             st["plq_counters"] = dict(self._plq_counters)
+            if self._key_intern:
+                st["key_intern"] = dict(self._key_intern)
         else:
             # deep copy: a live checkpoint resumes the stream after the
             # snapshot, and an aliased store would keep advancing
@@ -843,6 +868,8 @@ class WinSeqTPULogic(NodeLogic):
                     "replica runs the Python path")
             self._native.deserialize(state["native"])
             self._plq_counters = dict(state.get("plq_counters", {}))
+            self._key_intern = dict(state.get("key_intern", {}))
+            self._key_extern = {v: k for k, v in self._key_intern.items()}
         else:
             if self._native is not None:
                 raise RuntimeError(
