@@ -348,3 +348,92 @@ def test_mesh_mean_rejected_on_pane_farm():
     mesh2 = make_mesh(8, win_axis=2)
     with pytest.raises(ValueError, match="mean"):
         PaneFarmMesh(mesh2, 8, 4, WinType.TB, kind="mean")
+
+
+@pytest.mark.parametrize("geometry", [(8, 24), (16, 16), (1, 1),
+                                      (100, 10)])
+def test_key_farm_mesh_geometry_edges(geometry):
+    """KeyFarmMesh under degenerate geometries -- hopping once lost
+    every key's final window (gap ids returned last_window_of == -1,
+    so opened_max never reached it and EOS flush skipped it)."""
+    import threading
+
+    win, slide = geometry
+    n, nk = 4096, 16
+    mesh = make_mesh(8, win_axis=1)
+    state = {"sent": 0}
+
+    def src(ctx):
+        i = state["sent"]
+        if i >= n:
+            return None
+        m = min(512, n - i)
+        idx = i + np.arange(m)
+        state["sent"] = i + m
+        ids = idx // nk
+        return TupleBatch({"key": idx % nk, "id": ids, "ts": ids,
+                           "value": np.ones(m)})
+
+    tot = {"w": 0, "s": 0.0}
+    lock = threading.Lock()
+
+    def sink(item):
+        if item is None:
+            return
+        with lock:
+            if isinstance(item, TupleBatch):
+                tot["w"] += len(item)
+                tot["s"] += float(item["value"].sum())
+            else:
+                tot["w"] += 1
+                tot["s"] += item.value
+
+    g = wf.PipeGraph("mg", Mode.DEFAULT)
+    g.add_source(BatchSource(src)) \
+        .add(KeyFarmMesh(mesh, win, slide, WinType.TB, batch_windows=8)) \
+        .add_sink(Sink(sink))
+    g.run()
+    per_key = n // nk
+    ew, es, gi = 0, 0, 0
+    while gi * slide < per_key:
+        ew += 1
+        es += max(0, min(per_key, gi * slide + win) - gi * slide)
+        gi += 1
+    assert (tot["w"], tot["s"]) == (ew * nk, float(es * nk))
+
+
+def test_key_farm_mesh_sparse_hopping_no_empty_windows():
+    """A gap id far ahead must NOT fabricate empty windows between the
+    data and itself (and the populated window still fires): parity with
+    WinSeqTPU on the same sparse stream."""
+    import threading
+
+    ts = np.array([0, 1, 2, 3, 4, 5, 130], np.int64)
+    state = {"done": False}
+
+    def src(ctx):
+        if state["done"]:
+            return None
+        state["done"] = True
+        return TupleBatch({"key": np.zeros(len(ts), np.int64), "id": ts,
+                           "ts": ts, "value": np.ones(len(ts))})
+
+    got, lock = [], threading.Lock()
+
+    def sink(item):
+        if item is None:
+            return
+        with lock:
+            if isinstance(item, TupleBatch):
+                got.extend((int(item.id[j]), float(item["value"][j]))
+                           for j in range(len(item)))
+            else:
+                got.append((item.id, item.value))
+
+    g = wf.PipeGraph("sparse", Mode.DEFAULT)
+    g.add_source(BatchSource(src)) \
+        .add(KeyFarmMesh(make_mesh(8, win_axis=1), 8, 24, WinType.TB,
+                         batch_windows=4)) \
+        .add_sink(Sink(sink))
+    g.run()
+    assert sorted(got) == [(0, 6.0)], got

@@ -733,3 +733,41 @@ def test_string_keys_device_results_carry_original_keys():
     fresh.load_state(st)
     assert fresh._key_intern == logic._key_intern
     assert fresh._key_extern[logic._key_intern["beta"]] == "beta"
+
+
+def test_mixed_int_and_string_keys_device_batches():
+    """Int and string keys in ONE stream through the native device lane
+    with columnar output: int-only result batches stay columnar, any
+    batch carrying an interned key degrades to records, and every
+    original key appears on results."""
+    from windflow_tpu.core.tuples import TupleBatch as TB
+
+    seen, lock = set(), threading.Lock()
+
+    def sink(item):
+        if item is None:
+            return
+        with lock:
+            if isinstance(item, TB):
+                seen.update(int(k) for k in item.key)
+            else:
+                seen.add(item.key)
+
+    state = {"i": 0}
+
+    def src(shipper, ctx):
+        i = state["i"]
+        if i >= 400:
+            return False
+        key = i % 2 if i % 4 < 2 else f"s{i % 2}"
+        shipper.push(BasicRecord(key, i // 4, i // 4, 1.0))
+        state["i"] = i + 1
+        return True
+
+    g = wf.PipeGraph("mixed", Mode.DEFAULT)
+    g.add_source(wf.SourceBuilder(src).build()) \
+        .add(wf.WinSeqTPUBuilder("sum").withCBWindows(10, 5)
+             .withBatchOutput().build()) \
+        .add_sink(wf.SinkBuilder(sink).build())
+    g.run()
+    assert seen == {0, 1, "s0", "s1"}, seen

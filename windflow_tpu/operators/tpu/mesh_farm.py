@@ -68,6 +68,12 @@ class KeyFarmMeshLogic(NodeLogic):
                 st.next_fire = ((first - self.win_len)
                                 // self.slide_len + 1)
         keep = ids >= st.next_fire * self.slide_len
+        if self.win_len < self.slide_len:
+            # hopping: ids in the inter-window gaps belong to no window
+            # -- drop them BEFORE max_id/opened_max (win_seq_tpu does
+            # the same), else a gap id either loses the final window
+            # (if ignored) or fabricates empty ones (if counted)
+            keep &= (ids % self.slide_len) < self.win_len
         ids, vals = ids[keep], vals[keep]
         if len(ids) == 0:
             return
@@ -78,8 +84,8 @@ class KeyFarmMeshLogic(NodeLogic):
         st.max_id = max(st.max_id, int(ids.max()))
         last_w = wa.last_window_of(st.max_id, 0, self.win_len,
                                    self.slide_len)
-        if last_w >= 0:
-            st.opened_max = max(st.opened_max, last_w)
+        if last_w >= 0:   # gap ids were filtered above, so >= 0 unless
+            st.opened_max = max(st.opened_max, last_w)  # batch was empty
         while True:
             end = st.next_fire * self.slide_len + self.win_len
             if st.max_id < end or st.next_fire > st.opened_max:

@@ -263,6 +263,10 @@ class PaneFarmMesh(Operator):
         super().__init__(name, 1, RoutingMode.FORWARD,
                          Pattern.PANE_FARM_TPU)
         from ...parallel.sharded import ShardedWindowEngine
+        # NOTE: unlike the farm-based Pane_Farm planes (sliding-only,
+        # pane_farm.hpp:170-173), the epoch/ring decomposition has no
+        # PLQ renumbering to misalign, so tumbling and hopping windows
+        # are supported here (covered by test_mesh_farm geometry tests)
         self.win_type = win_type
         # the host pre-reduces panes, so the ring engine works in PANE
         # units: its window = wpp panes of width 1, slide = spp panes.

@@ -267,6 +267,7 @@ class WinSeqTPULogic(NodeLogic):
         # columnar/native stores and translated back on emission
         self._key_intern: Dict[Any, int] = {}
         self._key_extern: Dict[int, Any] = {}
+        self._saw_nonint_key = False
         cfg = self.config
         if (isinstance(win_kind, str)
                 and win_kind in ("sum", "count", "max", "min", "mean")
@@ -406,10 +407,15 @@ class WinSeqTPULogic(NodeLogic):
             self._plq_counters[key] = start + (hi - lo)
         return out
 
+    # interned ids live below _INTERN_CEIL, far outside any plausible
+    # user key, so a result batch can be tested for them vectorized
+    _INTERN_BASE = -(1 << 62)
+    _INTERN_CEIL = -(1 << 61)
+
     def _intern_key(self, key) -> int:
         iid = self._key_intern.get(key)
         if iid is None:
-            iid = -(1 << 62) + len(self._key_intern)
+            iid = self._INTERN_BASE + len(self._key_intern)
             self._key_intern[key] = iid
             self._key_extern[iid] = key
         return iid
@@ -420,7 +426,9 @@ class WinSeqTPULogic(NodeLogic):
             _, d_keys, d_gwids, d_rts = descs
             if self.role == Role.PLQ:
                 d_gwids = self._plq_renumber(d_keys)
-            if self.emit_batches and not self._key_extern:
+            has_interned = (bool(self._key_extern) and len(d_keys)
+                            and bool((d_keys < self._INTERN_CEIL).any()))
+            if self.emit_batches and not has_interned:
                 emit(TupleBatch({"key": d_keys, "id": d_gwids,
                                  "ts": d_rts,
                                  "value": np.asarray(results, np.float64)}))
@@ -437,8 +445,9 @@ class WinSeqTPULogic(NodeLogic):
                     emit(out)
             return
         if (self.emit_batches and self.role == Role.SEQ
-                and all(isinstance(d[0], (int, np.integer))
-                        for d in descs)):
+                and (not self._saw_nonint_key    # O(1) common case
+                     or all(isinstance(d[0], (int, np.integer))
+                            for d in descs))):
             # columnar emission: one result TupleBatch per device batch
             # (any non-integral key in the batch falls through to
             # record emission below -- int and string keys can mix)
@@ -740,6 +749,8 @@ class WinSeqTPULogic(NodeLogic):
         is_marker = isinstance(item, EOSMarker)
         t = item.record if is_marker else item
         key, tid, ts = t.get_control_fields()
+        if not isinstance(key, (int, np.integer)):
+            self._saw_nonint_key = True
         hashcode = default_hash(key)
         st = self._key_state(key)
         if self.renumbering and not is_marker:
