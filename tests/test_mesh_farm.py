@@ -350,17 +350,11 @@ def test_mesh_mean_rejected_on_pane_farm():
         PaneFarmMesh(mesh2, 8, 4, WinType.TB, kind="mean")
 
 
-@pytest.mark.parametrize("geometry", [(8, 24), (16, 16), (1, 1),
-                                      (100, 10)])
-def test_key_farm_mesh_geometry_edges(geometry):
-    """KeyFarmMesh under degenerate geometries -- hopping once lost
-    every key's final window (gap ids returned last_window_of == -1,
-    so opened_max never reached it and EOS flush skipped it)."""
-    import threading
 
-    win, slide = geometry
-    n, nk = 4096, 16
-    mesh = make_mesh(8, win_axis=1)
+
+def _run_geometry_oracle(op, n, nk, win, slide):
+    """Shared drive for the geometry-edge tests: uniform ones through
+    ``op``, returns (windows, sum, expected_windows, expected_sum)."""
     state = {"sent": 0}
 
     def src(ctx):
@@ -388,10 +382,8 @@ def test_key_farm_mesh_geometry_edges(geometry):
                 tot["w"] += 1
                 tot["s"] += item.value
 
-    g = wf.PipeGraph("mg", Mode.DEFAULT)
-    g.add_source(BatchSource(src)) \
-        .add(KeyFarmMesh(mesh, win, slide, WinType.TB, batch_windows=8)) \
-        .add_sink(Sink(sink))
+    g = wf.PipeGraph("geo", Mode.DEFAULT)
+    g.add_source(BatchSource(src)).add(op).add_sink(Sink(sink))
     g.run()
     per_key = n // nk
     ew, es, gi = 0, 0, 0
@@ -399,15 +391,26 @@ def test_key_farm_mesh_geometry_edges(geometry):
         ew += 1
         es += max(0, min(per_key, gi * slide + win) - gi * slide)
         gi += 1
-    assert (tot["w"], tot["s"]) == (ew * nk, float(es * nk))
+    return tot["w"], tot["s"], ew * nk, float(es * nk)
+
+
+@pytest.mark.parametrize("geometry", [(8, 24), (16, 16), (1, 1),
+                                      (100, 10)])
+def test_key_farm_mesh_geometry_edges(geometry):
+    """KeyFarmMesh under degenerate geometries -- hopping once lost
+    every key's final window (gap ids returned last_window_of == -1,
+    so opened_max never reached it and EOS flush skipped it)."""
+    win, slide = geometry
+    op = KeyFarmMesh(make_mesh(8, win_axis=1), win, slide, WinType.TB,
+                     batch_windows=8)
+    w, sm, ew, es = _run_geometry_oracle(op, 4096, 16, win, slide)
+    assert (w, sm) == (ew, es)
 
 
 def test_key_farm_mesh_sparse_hopping_no_empty_windows():
     """A gap id far ahead must NOT fabricate empty windows between the
     data and itself (and the populated window still fires): parity with
     WinSeqTPU on the same sparse stream."""
-    import threading
-
     ts = np.array([0, 1, 2, 3, 4, 5, 130], np.int64)
     state = {"done": False}
 
@@ -437,3 +440,17 @@ def test_key_farm_mesh_sparse_hopping_no_empty_windows():
         .add_sink(Sink(sink))
     g.run()
     assert sorted(got) == [(0, 6.0)], got
+
+
+@pytest.mark.parametrize("geometry", [(16, 16), (8, 24), (100, 10)])
+def test_pane_farm_mesh_geometry_edges(geometry):
+    """PaneFarmMesh supports tumbling/hopping/long windows (the epoch
+    decomposition has no PLQ renumbering to misalign, unlike the
+    sliding-only farm Pane_Farm planes) -- exact against the oracle."""
+    from windflow_tpu.operators.tpu.pane_mesh import PaneFarmMesh
+
+    win, slide = geometry
+    op = PaneFarmMesh(make_mesh(8, win_axis=2), win, slide, WinType.TB,
+                      panes_per_epoch=16)
+    w, sm, ew, es = _run_geometry_oracle(op, 4096, 4, win, slide)
+    assert (w, sm) == (ew, es)
