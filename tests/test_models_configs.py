@@ -1,4 +1,6 @@
 """The five BASELINE configs + the Yahoo flagship, end to end (small)."""
+import threading
+
 import numpy as np
 import pytest
 
@@ -70,3 +72,96 @@ def test_yahoo_step_fn_counts():
     c, w = 3, 1
     mask = (camp[ad] == c) & (et == VIEW) & (ts // 256 == w)
     assert out[c, w] == mask.sum()
+
+
+class TestNexmark:
+    """NEXMark query set (models/nexmark.py) against numpy oracles."""
+
+    def test_q1_q2_stateless(self):
+        from windflow_tpu.core.tuples import TupleBatch
+        from windflow_tpu.models.nexmark import (DOL_TO_EUR, q1_currency,
+                                                 make_q2_selection,
+                                                 synth_bids)
+
+        pool = synth_bids(10_000, n_auctions=50)
+        tb = TupleBatch({"key": pool["auction"], "id": pool["ts"],
+                         "ts": pool["ts"], "value": pool["price"]})
+        out = q1_currency(tb)
+        np.testing.assert_allclose(out["value"],
+                                   pool["price"] * DOL_TO_EUR)
+        q2 = make_q2_selection({3, 7, 11})
+        mask = q2(tb)
+        assert set(np.unique(tb.key[mask])) <= {3, 7, 11}
+        assert mask.sum() == np.isin(pool["auction"], [3, 7, 11]).sum()
+
+    def test_q5_hot_items(self):
+        import threading
+
+        from windflow_tpu.core.tuples import TupleBatch
+        from windflow_tpu.models.nexmark import synth_bids
+
+        N, NA, WINL, SL = 60_000, 40, 8192, 4096
+        got = {}
+        lock = threading.Lock()
+
+        def sink(item):
+            if item is None:
+                return
+            with lock:
+                if isinstance(item, TupleBatch):
+                    for j in range(len(item)):
+                        got[(int(item.key[j]), int(item.id[j]))] = \
+                            float(item["value"][j])
+                else:
+                    got[(item.key, item.id)] = item.value
+
+        from windflow_tpu.models.nexmark import build_q5_hot_items
+        g = wf.PipeGraph("q5", wf.Mode.DEFAULT)
+        build_q5_hot_items(g, N, WINL, SL, sink, n_auctions=NA,
+                           batch_size=16_384, device_batch=512)
+        g.run()
+
+        # oracle: counts per (auction, window)
+        pool = synth_bids(16_384, NA)
+        auctions = np.concatenate([
+            pool["auction"][:min(16_384, N - i)]
+            for i in range(0, N, 16_384)])
+        ts = np.arange(N)
+        expect = {}
+        for k in range(NA):
+            kts = ts[auctions == k]
+            w = 0
+            while w * SL <= kts.max():
+                expect[(k, w)] = float(
+                    ((kts >= w * SL) & (kts < w * SL + WINL)).sum())
+                w += 1
+        assert got == expect
+
+    def test_q7_highest_bid(self):
+        import threading
+
+        from windflow_tpu.models.nexmark import (DOL_TO_EUR,
+                                                 build_q7_highest_bid,
+                                                 synth_bids)
+
+        N, WINL = 50_000, 10_000
+        got = {}
+        lock = threading.Lock()
+
+        def sink(rec):
+            if rec is not None:
+                with lock:
+                    got[rec.id] = rec.value
+
+        g = wf.PipeGraph("q7", wf.Mode.DEFAULT)
+        build_q7_highest_bid(g, N, WINL, sink, batch_size=16_384,
+                             device_batch=256)
+        g.run()
+        pool = synth_bids(16_384, 1000)
+        prices = np.concatenate([
+            pool["price"][:min(16_384, N - i)]
+            for i in range(0, N, 16_384)]) * DOL_TO_EUR
+        for w in range(N // WINL):
+            exp = prices[w * WINL:(w + 1) * WINL].max()
+            # device computes in float32
+            assert abs(got[w] - exp) <= 1e-5 * abs(exp), (w, got[w], exp)

@@ -1,0 +1,123 @@
+"""NEXMark-style query set: the second benchmark application family.
+
+The reference ships its workloads as self-checking test pipelines
+(tests/mp_tests_*); the NEXMark auction queries are the streaming
+community's standard benchmark shapes, expressed here on the columnar
+plane with the device window operators:
+
+* Q1 currency conversion -- stateless BatchMap (price * rate)
+* Q2 selection           -- stateless BatchFilter (auction id set)
+* Q5 hot items           -- per-auction sliding-window bid counts,
+                            KeyFarmTPU 'count' (key_farm_gpu.hpp shape)
+* Q7 highest bid         -- global per-window maximum price,
+                            WinSeqTPU 'max' (win_seq_gpu.hpp shape)
+
+Synthetic bid stream: (auction, bidder, price, ts), ts dense.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+DOL_TO_EUR = 0.9
+
+
+def synth_bids(n_bids: int, n_auctions: int = 1000, seed: int = 7,
+               ts_start: int = 0):
+    """Columnar synthetic bid stream (NEXMark generator analogue)."""
+    rng = np.random.default_rng(seed)
+    return {
+        "auction": rng.integers(0, n_auctions, n_bids, dtype=np.int64),
+        "bidder": rng.integers(0, 10_000, n_bids, dtype=np.int64),
+        "price": rng.integers(1, 10_000, n_bids).astype(np.float64),
+        "ts": ts_start + np.arange(n_bids, dtype=np.int64),
+    }
+
+
+def bid_batches(n_bids: int, batch_size: int = 65_536,
+                n_auctions: int = 1000, seed: int = 7):
+    """BatchSource body emitting the synthetic bid stream as
+    TupleBatches keyed by auction (price in the value column)."""
+    from ..core.tuples import TupleBatch
+
+    pool = synth_bids(batch_size, n_auctions, seed)
+    state = {"sent": 0}
+
+    def source(ctx):
+        i = state["sent"]
+        if i >= n_bids:
+            return None
+        n = min(batch_size, n_bids - i)
+        ts = i + pool["ts"][:n]
+        state["sent"] = i + n
+        return TupleBatch({
+            "key": pool["auction"][:n], "id": ts, "ts": ts,
+            "value": pool["price"][:n],
+            "bidder": pool["bidder"][:n],
+        })
+
+    return source
+
+
+def q1_currency(batch):
+    """Q1: dollar -> euro conversion (BatchMap body)."""
+    return batch.with_cols(value=batch["value"] * DOL_TO_EUR)
+
+
+def make_q2_selection(auction_ids):
+    """Q2: keep only bids on the given auctions (BatchFilter body)."""
+    wanted = np.asarray(sorted(auction_ids), dtype=np.int64)
+
+    def q2(batch):
+        return np.isin(batch.key, wanted)
+
+    return q2
+
+
+def build_q5_hot_items(graph, n_bids: int, win_len: int, slide_len: int,
+                       sink, n_auctions: int = 1000,
+                       batch_size: int = 65_536, device_batch: int = 4096,
+                       parallelism: int = 1):
+    """Q5: per-auction bid counts over sliding time windows.  The
+    'hottest item' reduction is the sink's fold (max over each window
+    epoch); the windowed counts are the device-parallel part."""
+    import windflow_tpu as wf
+    from ..operators.basic_ops import Sink
+    from ..operators.batch_ops import BatchSource
+    from ..operators.tpu.farms_tpu import KeyFarmTPU
+
+    counter = KeyFarmTPU("count", win_len, slide_len, wf.WinType.TB,
+                         parallelism=parallelism, batch_len=device_batch,
+                         name="q5_counts", emit_batches=True)
+    graph.add_source(BatchSource(
+        bid_batches(n_bids, batch_size, n_auctions))) \
+        .add(counter).add_sink(Sink(sink, name="q5_sink"))
+    return graph
+
+
+def build_q7_highest_bid(graph, n_bids: int, win_len: int, sink,
+                         n_auctions: int = 1000,
+                         batch_size: int = 65_536,
+                         device_batch: int = 4096):
+    """Q7: highest price per tumbling window across ALL bids.  Bids are
+    funneled onto one key (the reference expresses global windows the
+    same way: a single keyed substream), Q1-converted first."""
+    from ..core.tuples import TupleBatch
+    from ..operators.basic_ops import Sink
+    from ..operators.batch_ops import BatchMap, BatchSource
+    from ..operators.tpu.win_seq_tpu import WinSeqTPU
+    from ..core.basic import WinType
+
+    def to_global_key(batch):
+        return TupleBatch({
+            "key": np.zeros(len(batch), np.int64),
+            "id": batch.id, "ts": batch.ts,
+            "value": batch["value"] * DOL_TO_EUR,
+        })
+
+    op = WinSeqTPU("max", win_len, win_len, WinType.TB,
+                   batch_len=device_batch, name="q7_max")
+    graph.add_source(BatchSource(
+        bid_batches(n_bids, batch_size, n_auctions))) \
+        .chain(BatchMap(to_global_key)) \
+        .add(op).add_sink(Sink(sink, name="q7_sink"))
+    return graph
