@@ -178,6 +178,40 @@ def test_win_seq_tpu_checkpoint_midstream(force_python):
             (k, got[k], want[k])
 
 
+def test_win_seq_tpu_restore_string_keys_python_path():
+    """A fresh replica restoring string-keyed Python-path state must not
+    take the columnar int64 emit shortcut on its first post-restore
+    launch (the flag is derived from the restored store, not left at
+    its constructor default)."""
+    from windflow_tpu.core.tuples import BasicRecord
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPULogic
+
+    def make_logic():
+        lg = WinSeqTPULogic("sum", 8, 8, WinType.CB, batch_len=4,
+                            emit_batches=True)
+        lg._native = None
+        return lg
+
+    def feed(logic, lo, hi, out):
+        for i in range(lo, hi):
+            r = BasicRecord(value=1.0)
+            r.set_control_fields("k%d" % (i % 2), i // 2, i)
+            logic.svc(r, 0, out.append)
+
+    a, out1 = make_logic(), []
+    feed(a, 0, 10, out1)  # 5 tuples/key: window 0 (win=8) not yet fired
+    a._drain_all(out1.append)
+    blob = pickle.dumps(a.state_dict())
+    b, out2 = make_logic(), []
+    b.load_state(pickle.loads(blob))
+    assert b._saw_nonint_key  # derived from the restored store
+    # launch WITHOUT any post-restore svc record (svc would re-set the
+    # flag itself): eos_flush fires the restored keys' pending windows
+    b.eos_flush(out2.append)
+    got = {(r.key, r.id): r.value for r in out1 + out2}
+    assert got == {("k0", 0): 5.0, ("k1", 0): 5.0}
+
+
 def test_native_snapshot_rejects_mismatched_config():
     from windflow_tpu.runtime.native import (NativeWindowEngine,
                                              native_available)

@@ -888,6 +888,13 @@ class WinSeqTPULogic(NodeLogic):
                     "replica runs the native engine")
             import copy
             self.keys = copy.deepcopy(state["keys"])
+            # re-derive the non-integral-key flag from the restored
+            # store (every descriptor's key is in it): the columnar
+            # emit shortcut keys off the flag, and a fresh replica
+            # restoring string-keyed state would otherwise crash in
+            # np.fromiter on the first launch
+            self._saw_nonint_key = any(
+                not isinstance(k, (int, np.integer)) for k in self.keys)
 
     def svc_end(self):
         # error-path teardown: eos_flush already drained (and cleared)
