@@ -240,7 +240,7 @@ def run_key_farm_tpu(n_events, par=2):
     g = wf.PipeGraph("bench4", wf.Mode.DEFAULT)
     op = KeyFarmTPU("sum", WIN, SLIDE, wf.WinType.TB, parallelism=par,
                     batch_len=DEVICE_BATCH, emit_batches=True,
-                    max_buffer_elems=MAX_BUFFER)
+                    max_buffer_elems=MAX_BUFFER, inflight_depth=INFLIGHT)
     g.add_source(BatchSource(_template_source(n_events, {}),
                              SOURCE_PARALLELISM)) \
         .add(op).add_sink(Sink(sink))

@@ -212,6 +212,56 @@ def test_win_seq_tpu_restore_string_keys_python_path():
     assert got == {("k0", 0): 5.0, ("k1", 0): 5.0}
 
 
+def test_restore_rejects_structure_mismatch(tmp_path):
+    """A snapshot from an N-replica farm must not restore silently into
+    a graph with fewer replicas (e.g. the coalesced lowering): the
+    unconsumed replica states would drop a fraction of every key's
+    mid-window state."""
+    import numpy as np
+    from windflow_tpu.core.tuples import TupleBatch
+    from windflow_tpu.operators.batch_ops import BatchSource
+    from windflow_tpu.operators.basic_ops import Sink
+    from windflow_tpu.operators.tpu.farms_tpu import KeyFarmTPU
+    from windflow_tpu.utils.checkpoint import save_graph, restore_graph
+
+    def build(coalesce):
+        sent = [False]
+
+        def src(ctx):
+            if sent[0]:
+                return None
+            sent[0] = True
+            n = 64
+            return TupleBatch({"key": np.arange(n, dtype=np.int64) % 4,
+                               "id": np.arange(n, dtype=np.int64) // 4,
+                               "ts": np.arange(n, dtype=np.int64) // 4,
+                               "value": np.ones(n, np.float32)})
+        g = wf.PipeGraph("mismatch", wf.Mode.DEFAULT)
+        op = KeyFarmTPU("sum", 8, 8, WinType.CB, parallelism=2,
+                        batch_len=4, coalesce=coalesce)
+        g.add_source(BatchSource(src)).add(op).add_sink(
+            wf.SinkBuilder(lambda r: None).build())
+        return g
+
+    g1 = build(coalesce=False)
+    g1.run()
+    path = str(tmp_path / "farm.pkl")
+    save_graph(g1, path)
+    g2 = build(coalesce=True)  # one engine: replica .1 has nowhere to go
+    with pytest.raises(RuntimeError, match="structure mismatch"):
+        restore_graph(g2, path)
+
+    # reverse direction: a coalesced (all-keys-in-one-engine) snapshot
+    # must not restore into an N-replica farm either -- replica .0
+    # would hold every key's state, .1 nothing
+    g3 = build(coalesce=True)
+    g3.run()
+    save_graph(g3, path)
+    g4 = build(coalesce=False)
+    with pytest.raises(RuntimeError, match="structure mismatch"):
+        restore_graph(g4, path)
+
+
 def test_native_snapshot_rejects_mismatched_config():
     from windflow_tpu.runtime.native import (NativeWindowEngine,
                                              native_available)

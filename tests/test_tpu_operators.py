@@ -188,13 +188,20 @@ def test_win_seq_tpu_pane_path_with_retained_tail(kind, agg, native_panes,
         assert got[k] == pytest.approx(expect, rel=1e-5)
 
 
+@pytest.mark.parametrize("coalesce", [True, False])
 @pytest.mark.parametrize("par", [1, 3])
 @pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
-def test_key_farm_tpu(par, win_type):
-    b = wf.KeyFarmTPUBuilder("sum").with_parallelism(par).with_batch(8)
+def test_key_farm_tpu(par, win_type, coalesce):
+    """Both lowerings must agree: the coalesced single engine (default)
+    and the literal N-replica farm with hash-partitioned keys."""
+    b = wf.KeyFarmTPUBuilder("sum").with_parallelism(par).with_batch(8) \
+        .with_coalesce(coalesce)
     b = (b.with_cb_windows(12, 4) if win_type == WinType.CB
          else b.with_tb_windows(12, 4))
-    coll = run_graph(b.build(), n_keys=5)
+    op = b.build()
+    coll = run_graph(op, n_keys=5)
+    n_reps = len(op.stages()[0].replicas)
+    assert n_reps == (1 if coalesce else par)
     expect = oracle(48, 12, 4)
     assert coll.by_key() == {k: expect for k in range(5)}
 
@@ -245,11 +252,12 @@ def test_win_mapreduce_tpu(map_on_tpu):
         assert got[k] == expect, (k, got[k])
 
 
+@pytest.mark.parametrize("coalesce", [True, False])
 @pytest.mark.parametrize("win_type", [WinType.CB, WinType.TB])
-def test_key_ffat_tpu(win_type):
+def test_key_ffat_tpu(win_type, coalesce):
     import jax.numpy as jnp
     b = wf.KeyFFATTPUBuilder(lambda t: t.value, (jnp.add, 0.0)) \
-        .with_parallelism(2).with_batch(8)
+        .with_parallelism(2).with_batch(8).with_coalesce(coalesce)
     b = (b.with_cb_windows(12, 4) if win_type == WinType.CB
          else b.with_tb_windows(12, 4))
     coll = run_graph(b.build(), n_keys=4)

@@ -17,12 +17,15 @@ from ..operators.tpu.farms_tpu import (KeyFarmTPU, KeyFFATTPU, PaneFarmTPU,
                                        WinFarmTPU, WinMapReduceTPU,
                                        WinSeqFFATTPU)
 from ..operators.tpu.win_seq_tpu import (DEFAULT_BATCH_LEN,
+    DEFAULT_INFLIGHT_DEPTH, DEFAULT_MAX_BATCH_DELAY_MS,
     DEFAULT_MAX_BUFFER_ELEMS, WinSeqTPU)
 from .builders import _BuilderBase, _WinBuilderBase, _alias_camel
 
 
 class _TPUBuilderMixin:
     max_buffer_elems = DEFAULT_MAX_BUFFER_ELEMS
+    inflight_depth = DEFAULT_INFLIGHT_DEPTH
+    max_batch_delay_ms = DEFAULT_MAX_BATCH_DELAY_MS
 
     def with_batch(self, batch_len: int):
         self.batch_len = batch_len
@@ -53,6 +56,35 @@ class _TPUBuilderMixin:
         self.emit_batches = on
         return self
 
+    def with_inflight(self, depth: int):
+        """Device launches kept in flight before the oldest is flushed
+        (the waitAndFlush pipeline depth, win_seq_gpu.hpp:267-297).
+        Nested farms (a farm builder wrapping a PaneFarmTPU /
+        WinMapReduceTPU) take their depth from the INNER operator's
+        builder; this knob applies to non-nested builds."""
+        self.inflight_depth = depth
+        return self
+
+    def with_max_batch_delay(self, ms: float):
+        """Partial-batch launch trigger: ready windows launch at most
+        this long after the previous launch (the latency half of the
+        adaptive batch resize, win_seq_gpu.hpp:574-592)."""
+        self.max_batch_delay_ms = ms
+        return self
+
+
+class _KeyShardedMixin:
+    """Knobs that only make sense on key-sharded device farms."""
+
+    def with_coalesce(self, on: bool = True):
+        """Lower same-device replicas to one engine handling all keys
+        per launch (default on -- see KeyFarmTPU).  Off keeps the
+        literal N-replica farm.  Nested farms (KeyFarm over
+        PaneFarmTPU/WinMapReduceTPU) ignore this: their replication IS
+        the requested composite structure."""
+        self.coalesce = on
+        return self
+
 
 
 @_alias_camel
@@ -75,7 +107,9 @@ class WinSeqTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                          self.triggering_delay, self.name,
                          self.result_factory, self.value_of,
                          self.closing_func, self.emit_batches,
-                         max_buffer_elems=self.max_buffer_elems)
+                         max_buffer_elems=self.max_buffer_elems,
+                         inflight_depth=self.inflight_depth,
+                         max_batch_delay_ms=self.max_batch_delay_ms)
 
 
 @_alias_camel
@@ -108,11 +142,14 @@ class WinFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                           self.triggering_delay, self.name,
                           self.result_factory, self.value_of, self.ordered,
                           self.opt_level,
-                          max_buffer_elems=self.max_buffer_elems)
+                          max_buffer_elems=self.max_buffer_elems,
+                          inflight_depth=self.inflight_depth,
+                          max_batch_delay_ms=self.max_batch_delay_ms)
 
 
 @_alias_camel
-class KeyFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
+class KeyFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin,
+                        _KeyShardedMixin):
     """builders_gpu.hpp:713 analogue."""
 
     _default_name = "key_farm_tpu"
@@ -123,6 +160,7 @@ class KeyFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
         self.value_of = None
         self.device_index = 0
         self.emit_batches = False
+        self.coalesce = True
 
     def build(self):
         from ..operators.nesting import NestedKeyFarm
@@ -136,7 +174,10 @@ class KeyFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                           self.triggering_delay, self.name,
                           self.result_factory, self.value_of,
                           emit_batches=self.emit_batches,
-                          max_buffer_elems=self.max_buffer_elems)
+                          max_buffer_elems=self.max_buffer_elems,
+                          coalesce=self.coalesce,
+                          inflight_depth=self.inflight_depth,
+                          max_batch_delay_ms=self.max_batch_delay_ms)
 
 
 @_alias_camel
@@ -171,7 +212,9 @@ class PaneFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                            self.batch_len, self.triggering_delay, self.name,
                            self.result_factory, self.value_of, self.ordered,
                            self.opt_level,
-                           max_buffer_elems=self.max_buffer_elems)
+                           max_buffer_elems=self.max_buffer_elems,
+                           inflight_depth=self.inflight_depth,
+                           max_batch_delay_ms=self.max_batch_delay_ms)
 
 
 @_alias_camel
@@ -206,7 +249,9 @@ class WinMapReduceTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                                self.triggering_delay, self.name,
                                self.result_factory, self.value_of,
                                self.ordered,
-                               max_buffer_elems=self.max_buffer_elems)
+                               max_buffer_elems=self.max_buffer_elems,
+                               inflight_depth=self.inflight_depth,
+                               max_batch_delay_ms=self.max_batch_delay_ms)
 
 
 @_alias_camel
@@ -263,11 +308,14 @@ class WinSeqFFATTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                              self.slide_len, self.win_type, self.batch_len,
                              self.triggering_delay, self.name,
                              self.result_factory,
-                             max_buffer_elems=self.max_buffer_elems)
+                             max_buffer_elems=self.max_buffer_elems,
+                             inflight_depth=self.inflight_depth,
+                             max_batch_delay_ms=self.max_batch_delay_ms)
 
 
 @_alias_camel
-class KeyFFATTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
+class KeyFFATTPUBuilder(_WinBuilderBase, _TPUBuilderMixin,
+                        _KeyShardedMixin):
     """builders_gpu.hpp:1003 analogue (lift + combine, key-sharded)."""
 
     _default_name = "key_ffat_tpu"
@@ -277,6 +325,7 @@ class KeyFFATTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
         self.combine = combine
         self.batch_len = DEFAULT_BATCH_LEN
         self.device_index = 0
+        self.coalesce = True
 
     def build(self) -> KeyFFATTPU:
         self._check_windows()
@@ -284,4 +333,7 @@ class KeyFFATTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                           self.slide_len, self.win_type, self.parallelism,
                           self.batch_len, self.triggering_delay, self.name,
                           self.result_factory,
-                          max_buffer_elems=self.max_buffer_elems)
+                          max_buffer_elems=self.max_buffer_elems,
+                          coalesce=self.coalesce,
+                          inflight_depth=self.inflight_depth,
+                          max_batch_delay_ms=self.max_batch_delay_ms)

@@ -33,7 +33,8 @@ from ...runtime.win_routing import KFEmitter, WFEmitter, WidOrderCollector, \
     WinMapEmitter
 from ..base import Operator, StageSpec
 from ..win_seq import WinSeqLogic
-from .win_seq_tpu import (DEFAULT_BATCH_LEN,
+from .win_seq_tpu import (DEFAULT_BATCH_LEN, DEFAULT_INFLIGHT_DEPTH,
+                          DEFAULT_MAX_BATCH_DELAY_MS,
                           DEFAULT_MAX_BUFFER_ELEMS, WinSeqTPULogic)
 
 
@@ -41,7 +42,9 @@ def _tpu_replicas(win_kind, win_len, slide_len, win_type, par, *,
                   batch_len, triggering_delay, result_factory, value_of,
                   enclosing: WinOperatorConfig, role: Role,
                   farm_kind: str, renumbering=False, emit_batches=False,
-                  max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS):
+                  max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS,
+                  inflight_depth=DEFAULT_INFLIGHT_DEPTH,
+                  max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS):
     """Build the worker set with the same config conventions as the CPU
     farms (win_farm.hpp:175 / key_farm worker configs)."""
     reps = []
@@ -65,7 +68,8 @@ def _tpu_replicas(win_kind, win_len, slide_len, win_type, par, *,
             map_indexes=(i, par) if role == Role.MAP else (0, 1),
             parallelism=par, replica_index=i, renumbering=renumbering,
             value_of=value_of, emit_batches=emit_batches,
-            max_buffer_elems=max_buffer_elems))
+            max_buffer_elems=max_buffer_elems, inflight_depth=inflight_depth,
+            max_batch_delay_ms=max_batch_delay_ms))
     return reps
 
 
@@ -84,12 +88,27 @@ class _TPUWinOp(Operator):
 
 
 class KeyFarmTPU(_TPUWinOp):
+    """Key-sharded device windows (key_farm_gpu.hpp:751).
+
+    ``coalesce`` (default on): replicas of this farm all dispatch to the
+    SAME local device -- a key split across N engine replicas buys no
+    device parallelism, it only multiplies host dispatcher threads that
+    contend for the ingest core and serialize launches.  The farm
+    therefore lowers to ONE engine handling every key per launch (the
+    engine batches many keys natively; the double-buffer protocol of
+    win_seq_gpu.hpp:267-297 rides one launch stream).  Key-partitioned
+    scale-out across chips is the mesh plane's job
+    (operators/tpu/mesh_farm.KeyFarmMesh).  ``coalesce=False`` keeps
+    the literal N-replica farm (the reference's per-GPU structure)."""
+
     def __init__(self, win_kind, win_len, slide_len, win_type,
                  parallelism=1, batch_len=DEFAULT_BATCH_LEN,
                  triggering_delay=0, name="key_farm_tpu",
                  result_factory=BasicRecord, value_of=None,
                  config: WinOperatorConfig = None, emit_batches=False,
-                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS):
+                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS,
+                 coalesce=True, inflight_depth=DEFAULT_INFLIGHT_DEPTH,
+                 max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS):
         super().__init__(name, parallelism, RoutingMode.KEYBY,
                          Pattern.KEY_FARM_TPU, win_type)
         self.args = (win_kind, win_len, slide_len, win_type)
@@ -100,17 +119,26 @@ class KeyFarmTPU(_TPUWinOp):
         self.config = config or WinOperatorConfig(0, 1, 0, 0, 1, 0)
         self.emit_batches = emit_batches
         self.max_buffer_elems = max_buffer_elems
+        self.coalesce = coalesce
+        self.inflight_depth = inflight_depth
+        self.max_batch_delay_ms = max_batch_delay_ms
 
     def stages(self):
         kind, win_len, slide_len, win_type = self.args
+        # every kf replica runs the identical engine config (the key
+        # subset comes only from the emitter hash), so one engine over
+        # all keys computes the same windows
+        par = 1 if self.coalesce else self.parallelism
         reps = _tpu_replicas(
-            kind, win_len, slide_len, win_type, self.parallelism,
+            kind, win_len, slide_len, win_type, par,
             batch_len=self.batch_len, triggering_delay=self.triggering_delay,
             result_factory=self.result_factory, value_of=self.value_of,
             enclosing=self.config, role=Role.SEQ, farm_kind="kf",
             renumbering=self._renumbering, emit_batches=self.emit_batches,
-            max_buffer_elems=self.max_buffer_elems)
-        return [StageSpec(self.name, reps, KFEmitter(self.parallelism),
+            max_buffer_elems=self.max_buffer_elems,
+            inflight_depth=self.inflight_depth,
+            max_batch_delay_ms=self.max_batch_delay_ms)
+        return [StageSpec(self.name, reps, KFEmitter(par),
                           self.routing, ordering_mode=self._ordering())]
 
 
@@ -121,10 +149,14 @@ class WinFarmTPU(_TPUWinOp):
                  result_factory=BasicRecord, value_of=None, ordered=True,
                  opt_level=OptLevel.LEVEL0,
                  config: WinOperatorConfig = None, role: Role = Role.SEQ,
-                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS):
+                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS,
+                 inflight_depth=DEFAULT_INFLIGHT_DEPTH,
+                 max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS):
         super().__init__(name, parallelism, RoutingMode.COMPLEX,
                          Pattern.WIN_FARM_TPU, win_type)
         self.max_buffer_elems = max_buffer_elems
+        self.inflight_depth = inflight_depth
+        self.max_batch_delay_ms = max_batch_delay_ms
         self.args = (win_kind, win_len, slide_len, win_type)
         self.batch_len = batch_len
         self.triggering_delay = triggering_delay
@@ -143,7 +175,9 @@ class WinFarmTPU(_TPUWinOp):
             batch_len=self.batch_len, triggering_delay=self.triggering_delay,
             result_factory=self.result_factory, value_of=self.value_of,
             enclosing=cfg, role=self.role, farm_kind="wf",
-            max_buffer_elems=self.max_buffer_elems)
+            max_buffer_elems=self.max_buffer_elems,
+            inflight_depth=self.inflight_depth,
+            max_batch_delay_ms=self.max_batch_delay_ms)
         emitter = WFEmitter(win_len, slide_len, self.parallelism, win_type,
                             self.role, id_outer=cfg.id_inner,
                             n_outer=cfg.n_inner, slide_outer=cfg.slide_inner)
@@ -166,7 +200,9 @@ class PaneFarmTPU(_TPUWinOp):
                  result_factory=BasicRecord, value_of=None, ordered=True,
                  opt_level=OptLevel.LEVEL0,
                  config: WinOperatorConfig = None,
-                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS):
+                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS,
+                 inflight_depth=DEFAULT_INFLIGHT_DEPTH,
+                 max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS):
         super().__init__(name, plq_parallelism + wlq_parallelism,
                          RoutingMode.COMPLEX, Pattern.PANE_FARM_TPU,
                          win_type)
@@ -197,6 +233,8 @@ class PaneFarmTPU(_TPUWinOp):
         self.opt_level = opt_level
         self.pane_len = pane_length(win_len, slide_len)
         self.max_buffer_elems = max_buffer_elems
+        self.inflight_depth = inflight_depth
+        self.max_batch_delay_ms = max_batch_delay_ms
         # enclosing config: identity standalone, nested arithmetic when
         # replicated inside a Win_Farm/Key_Farm (win_farm_gpu.hpp:73-76)
         self.config = config or WinOperatorConfig(0, 1, slide_len,
@@ -210,7 +248,9 @@ class PaneFarmTPU(_TPUWinOp):
             triggering_delay=delay, result_factory=self.result_factory,
             value_of=self.value_of, enclosing=self.config, role=role,
             farm_kind="seq",
-            max_buffer_elems=self.max_buffer_elems)[0]
+            max_buffer_elems=self.max_buffer_elems,
+            inflight_depth=self.inflight_depth,
+            max_batch_delay_ms=self.max_batch_delay_ms)[0]
 
     def _host_single(self, fn, win, slide, win_type, role, delay=0):
         cfg = self.config
@@ -263,7 +303,9 @@ class PaneFarmTPU(_TPUWinOp):
                 result_factory=self.result_factory, value_of=self.value_of,
                 enclosing=cfg, role=Role.PLQ,
                 farm_kind="wf" if self.plq_par > 1 else "seq",
-                max_buffer_elems=self.max_buffer_elems)
+                max_buffer_elems=self.max_buffer_elems,
+                inflight_depth=self.inflight_depth,
+                max_batch_delay_ms=self.max_batch_delay_ms)
             # the enclosing offsets shift pane membership when this
             # operator is a nested copy (the configSeq construction,
             # win_farm.hpp:175; emitter without them routes panes
@@ -295,7 +337,9 @@ class PaneFarmTPU(_TPUWinOp):
                 result_factory=self.result_factory, value_of=self.value_of,
                 enclosing=cfg, role=Role.WLQ,
                 farm_kind="wf" if self.wlq_par > 1 else "seq",
-                max_buffer_elems=self.max_buffer_elems)
+                max_buffer_elems=self.max_buffer_elems,
+                inflight_depth=self.inflight_depth,
+                max_batch_delay_ms=self.max_batch_delay_ms)
             emitter = (WFEmitter(wlq_win, wlq_slide, self.wlq_par,
                                  WinType.CB, Role.WLQ,
                                  id_outer=cfg.id_inner, n_outer=cfg.n_inner,
@@ -338,7 +382,9 @@ class WinMapReduceTPU(_TPUWinOp):
                  triggering_delay=0, name="win_mr_tpu",
                  result_factory=BasicRecord, value_of=None, ordered=True,
                  config: WinOperatorConfig = None,
-                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS):
+                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS,
+                 inflight_depth=DEFAULT_INFLIGHT_DEPTH,
+                 max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS):
         super().__init__(name, map_parallelism + reduce_parallelism,
                          RoutingMode.COMPLEX, Pattern.WIN_MAPREDUCE_TPU,
                          win_type)
@@ -355,6 +401,8 @@ class WinMapReduceTPU(_TPUWinOp):
         self.value_of = value_of
         self.ordered = ordered
         self.max_buffer_elems = max_buffer_elems
+        self.inflight_depth = inflight_depth
+        self.max_batch_delay_ms = max_batch_delay_ms
         self.config = config or WinOperatorConfig(0, 1, slide_len,
                                                   0, 1, slide_len)
 
@@ -376,7 +424,9 @@ class WinMapReduceTPU(_TPUWinOp):
                                              self.slide_len),
                     role=Role.MAP, map_indexes=(i, mp), parallelism=mp,
                     replica_index=i, value_of=self.value_of,
-                    max_buffer_elems=self.max_buffer_elems))
+                    max_buffer_elems=self.max_buffer_elems,
+                    inflight_depth=self.inflight_depth,
+                    max_batch_delay_ms=self.max_batch_delay_ms))
         else:
             reps = [WinSeqLogic(
                 self.map_stage, self.win_len, self.slide_len, self.win_type,
@@ -405,7 +455,9 @@ class WinMapReduceTPU(_TPUWinOp):
                 batch_len=self.batch_len, triggering_delay=0,
                 result_factory=self.result_factory, value_of=self.value_of,
                 enclosing=cfg, role=Role.REDUCE, farm_kind="seq",
-                max_buffer_elems=self.max_buffer_elems)
+                max_buffer_elems=self.max_buffer_elems,
+                inflight_depth=self.inflight_depth,
+                max_batch_delay_ms=self.max_batch_delay_ms)
         stages.append(StageSpec(
             f"{self.name}_reduce", logic, StandardEmitter(keyed=True),
             RoutingMode.KEYBY, ordering_mode=OrderingMode.ID))
@@ -430,12 +482,16 @@ class WinSeqFFATTPU(_TPUWinOp):
     def __init__(self, lift: Callable, combine: Any, win_len, slide_len,
                  win_type, batch_len=DEFAULT_BATCH_LEN, triggering_delay=0,
                  name="win_seqffat_tpu", result_factory=BasicRecord,
-                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS):
+                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS,
+                 inflight_depth=DEFAULT_INFLIGHT_DEPTH,
+                 max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS):
         super().__init__(name, 1, RoutingMode.FORWARD,
                          Pattern.WIN_SEQFFAT_TPU, win_type)
         self.kind = _ffat_kind(combine)
         self.lift = lift
         self.max_buffer_elems = max_buffer_elems
+        self.inflight_depth = inflight_depth
+        self.max_batch_delay_ms = max_batch_delay_ms
         self.args = (win_len, slide_len, win_type, batch_len,
                      triggering_delay, result_factory)
 
@@ -445,36 +501,48 @@ class WinSeqFFATTPU(_TPUWinOp):
             self.kind, win_len, slide_len, win_type, batch_len=batch_len,
             triggering_delay=delay, result_factory=rf, value_of=self.lift,
             renumbering=self._renumbering,
-            max_buffer_elems=self.max_buffer_elems)
+            max_buffer_elems=self.max_buffer_elems,
+            inflight_depth=self.inflight_depth,
+            max_batch_delay_ms=self.max_batch_delay_ms)
         return [StageSpec(self.name, [logic], StandardEmitter(),
                           self.routing, ordering_mode=self._ordering())]
 
 
 class KeyFFATTPU(_TPUWinOp):
-    """Key-sharded device FFAT farm (key_ffat_gpu.hpp:18-35)."""
+    """Key-sharded device FFAT farm (key_ffat_gpu.hpp:18-35).  Same
+    single-device coalescing as KeyFarmTPU (see there): identical
+    replica configs, so one engine over all keys is equivalent."""
 
     def __init__(self, lift: Callable, combine: Any, win_len, slide_len,
                  win_type, parallelism=1, batch_len=DEFAULT_BATCH_LEN,
                  triggering_delay=0, name="key_ffat_tpu",
                  result_factory=BasicRecord,
-                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS):
+                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS, coalesce=True,
+                 inflight_depth=DEFAULT_INFLIGHT_DEPTH,
+                 max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS):
         super().__init__(name, parallelism, RoutingMode.KEYBY,
                          Pattern.KEY_FFAT_TPU, win_type)
         self.kind = _ffat_kind(combine)
         self.lift = lift
         self.max_buffer_elems = max_buffer_elems
+        self.coalesce = coalesce
+        self.inflight_depth = inflight_depth
+        self.max_batch_delay_ms = max_batch_delay_ms
         self.args = (win_len, slide_len, win_type, batch_len,
                      triggering_delay, result_factory)
 
     def stages(self):
         win_len, slide_len, win_type, batch_len, delay, rf = self.args
+        par = 1 if self.coalesce else self.parallelism
         reps = [WinSeqTPULogic(
             self.kind, win_len, slide_len, win_type, batch_len=batch_len,
             triggering_delay=delay, result_factory=rf, value_of=self.lift,
             config=WinOperatorConfig(0, 1, 0, 0, 1, slide_len),
-            parallelism=self.parallelism, replica_index=i,
+            parallelism=par, replica_index=i,
             renumbering=self._renumbering,
-            max_buffer_elems=self.max_buffer_elems)
-            for i in range(self.parallelism)]
-        return [StageSpec(self.name, reps, KFEmitter(self.parallelism),
+            max_buffer_elems=self.max_buffer_elems,
+            inflight_depth=self.inflight_depth,
+            max_batch_delay_ms=self.max_batch_delay_ms)
+            for i in range(par)]
+        return [StageSpec(self.name, reps, KFEmitter(par),
                           self.routing, ordering_mode=self._ordering())]

@@ -39,6 +39,10 @@ from ..base import Operator, StageSpec
 DEFAULT_BATCH_LEN = 256
 # host staging-buffer capacity (elements) before a forced flush
 DEFAULT_MAX_BUFFER_ELEMS = 1 << 19
+# device launches kept in flight before the oldest is flushed
+DEFAULT_INFLIGHT_DEPTH = 4
+# partial-batch launch trigger (latency bound), milliseconds
+DEFAULT_MAX_BATCH_DELAY_MS = 10.0
 
 
 def _key_groups(keys: np.ndarray):
@@ -202,9 +206,10 @@ class WinSeqTPULogic(NodeLogic):
                  replica_index: int = 0, renumbering: bool = False,
                  value_of: Callable[[Any], float] = None,
                  closing_func: Callable = None, emit_batches: bool = False,
-                 max_buffer_elems: int = DEFAULT_MAX_BUFFER_ELEMS, inflight_depth: int = 4,
+                 max_buffer_elems: int = DEFAULT_MAX_BUFFER_ELEMS,
+                 inflight_depth: int = DEFAULT_INFLIGHT_DEPTH,
                  async_dispatch: bool = True,
-                 max_batch_delay_ms: float = 10.0):
+                 max_batch_delay_ms: float = DEFAULT_MAX_BATCH_DELAY_MS):
         if win_len == 0 or slide_len == 0:
             raise ValueError("win_len and slide_len must be > 0")
         self.engine = WindowComputeEngine(win_kind)
@@ -916,8 +921,10 @@ class WinSeqTPU(Operator):
                  batch_len=DEFAULT_BATCH_LEN, triggering_delay=0,
                  name="win_seq_tpu", result_factory=BasicRecord,
                  value_of=None, closing_func=None, emit_batches=False,
-                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS, inflight_depth=4,
-                 async_dispatch=True, max_batch_delay_ms=10.0):
+                 max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS,
+                 inflight_depth=DEFAULT_INFLIGHT_DEPTH,
+                 async_dispatch=True,
+                 max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS):
         super().__init__(name, 1, RoutingMode.FORWARD, Pattern.WIN_SEQ_TPU)
         self.win_type = win_type
         self.kwargs = dict(

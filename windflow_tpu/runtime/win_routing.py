@@ -158,6 +158,9 @@ class KFEmitter(Emitter):
         self.routing = routing or (lambda h, n: h % n)
 
     def emit(self, item, send_to):
+        if self.pardegree == 1:
+            send_to(0, item)  # all keys to the one worker: skip hashing
+            return
         from ..core.tuples import TupleBatch
         if isinstance(item, TupleBatch):
             import numpy as np

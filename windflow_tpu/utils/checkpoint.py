@@ -45,19 +45,36 @@ def save_graph(graph, path: str) -> None:
 
 def restore_graph(graph, path: str) -> int:
     """Load state into a structurally identical graph (same operator
-    names/parallelisms).  Returns the number of replicas restored."""
+    names/parallelisms).  Returns the number of replicas restored.
+
+    Raises BEFORE loading anything if the snapshot's stateful-node
+    names differ from this graph's: in either direction the resume
+    would silently run with misdistributed window state (e.g. an
+    N-replica farm snapshot into a coalesced single-engine lowering,
+    or vice versa).  Which nodes are stateful is determined by the
+    graph structure, not by stream data, so set equality is the
+    structure check."""
     with open(path, "rb") as f:
         states = pickle.load(f)
-    n = 0
+    loadable = {}
     for node in graph._all_nodes():
-        st = states.get(node.name)
-        if st is None:
+        if getattr(node.logic, "load_state", None) is None:
             continue
-        loader = getattr(node.logic, "load_state", None)
-        if loader is not None:
-            loader(st)
-            n += 1
-    return n
+        getter = getattr(node.logic, "state_dict", None)
+        if getter is None or getter() is None:
+            continue  # stateless here => stateless in the saved twin
+        loadable[node.name] = node.logic
+    extra = set(states) - set(loadable)
+    missing = set(loadable) - set(states)
+    if extra or missing:
+        raise RuntimeError(
+            f"snapshot/graph structure mismatch (e.g. different "
+            f"parallelism or coalesce setting than at save time): "
+            f"snapshot-only nodes {sorted(extra)}, "
+            f"graph-only nodes {sorted(missing)}; nothing was restored")
+    for name, logic in loadable.items():
+        logic.load_state(states[name])
+    return len(loadable)
 
 
 def run_with_recovery(graph_factory, checkpoint_path: str,
