@@ -235,6 +235,130 @@ def test_pane_farm_tpu(plq_on_tpu):
         assert got[k] == expect, (k, got[k])
 
 
+@pytest.mark.parametrize("opt_level", [wf.OptLevel.LEVEL0,
+                                       wf.OptLevel.LEVEL2])
+@pytest.mark.parametrize("kind,agg", [("sum", sum), ("max", max),
+                                      ("min", min)])
+def test_pane_farm_tpu_columnar_wlq(kind, agg, opt_level):
+    """A builtin-name host WLQ takes the columnar pane->window combine;
+    results must equal both the oracle and the callable-WLQ path
+    (which stays on the per-record engine)."""
+    def host_comb(gwid, iterable, result):
+        result.value = agg(t.value for t in iterable)
+
+    results = {}
+    for wlq in (kind, host_comb):
+        b = wf.PaneFarmTPUBuilder(kind, wlq).with_parallelism(1, 1) \
+            .with_batch(8).with_tb_windows(12, 4)
+        b.opt_level = opt_level
+        op = b.build()
+        assert op._wlq_columnar == isinstance(wlq, str)
+        coll = run_graph(op)
+        results[isinstance(wlq, str)] = coll.by_key()
+    expect = oracle(48, 12, 4, agg=agg)
+    for columnar, got in results.items():
+        for k in range(3):
+            assert got[k] == pytest.approx(expect, rel=1e-9), \
+                (columnar, k, got[k])
+
+
+def test_pane_farm_tpu_columnar_wlq_batch_output_and_par():
+    """Columnar WLQ with keyed parallelism and TupleBatch output."""
+    sink_batches = []
+    lock = threading.Lock()
+
+    class BatchSink:
+        def __call__(self, item):
+            from windflow_tpu.core.tuples import TupleBatch
+            if item is None:
+                return
+            with lock:
+                if isinstance(item, TupleBatch):
+                    for i in range(len(item)):
+                        sink_batches.append((int(item.key[i]),
+                                             int(item.id[i]),
+                                             float(item["value"][i])))
+                else:
+                    sink_batches.append((item.key, item.id, item.value))
+
+    b = wf.PaneFarmTPUBuilder("sum", "sum").with_parallelism(1, 2) \
+        .with_batch(8).with_tb_windows(12, 4).with_batch_output()
+    g = wf.PipeGraph("pcb", Mode.DEFAULT)
+    g.add_source(wf.SourceBuilder(ordered_source(4, 48)).build()) \
+        .add(b.build()).add_sink(wf.SinkBuilder(BatchSink()).build())
+    g.run()
+    got = {}
+    for k, w, v in sink_batches:
+        got.setdefault(k, {})[w] = v
+    expect = oracle(48, 12, 4)
+    assert set(got) == set(range(4))
+    for k in got:
+        assert got[k] == pytest.approx(expect, rel=1e-9)
+
+
+def test_nested_pane_farm_builtin_wlq_falls_back_to_record_engine():
+    """Nested copies carry non-identity configs (striped/offset window
+    ids) the columnar WLQ cannot reproduce; a builtin-name WLQ must
+    fall back to the stock per-record engine there and match the
+    callable-WLQ nesting exactly."""
+    from windflow_tpu.operators.nesting import _clone_inner
+
+    def host_comb(gwid, it, res):
+        res.value = sum(t.value for t in it)
+
+    results = {}
+    for wlq in ("sum", host_comb):
+        inner = wf.PaneFarmTPUBuilder("sum", wlq) \
+            .with_parallelism(2, 1).with_tb_windows(12, 4).build()
+        if isinstance(wlq, str):
+            assert inner._wlq_columnar  # identity config: columnar ok
+            copy = _clone_inner(inner, 1, 2, 4, 8)
+            assert not copy._wlq_columnar  # nested: falls back
+        op = wf.WinFarmTPUBuilder(inner).with_parallelism(2).build()
+        coll = run_graph(op)
+        results[isinstance(wlq, str)] = coll.by_key()
+    expect = oracle(48, 12, 4)
+    for columnar, got in results.items():
+        for k in range(3):
+            assert got[k] == pytest.approx(expect, rel=1e-9), \
+                (columnar, k, got[k])
+
+
+def test_pane_farm_tpu_rejects_unsupported_builtin_wlq():
+    with pytest.raises(ValueError, match="builtin"):
+        wf.PaneFarmTPUBuilder("count", "count") \
+            .with_tb_windows(12, 4).build()
+
+
+def test_pane_combine_logic_out_of_order_and_checkpoint():
+    """Pane ids arriving out of order park until the gap fills; a
+    snapshot taken mid-stream resumes exactly."""
+    import pickle
+    from windflow_tpu.operators.tpu.pane_combine import PaneCombineLogic
+
+    def feed(lg, seq, out):
+        for pid, v in seq:
+            r = BasicRecord(7, pid, pid, v)
+            lg.svc(r, 0, out.append)
+
+    ref_lg, ref_out = PaneCombineLogic("sum", 3, 1), []
+    feed(ref_lg, [(i, float(i)) for i in range(8)], ref_out)
+    ref_lg.eos_flush(ref_out.append)
+
+    lg, out = PaneCombineLogic("sum", 3, 1), []
+    feed(lg, [(0, 0.0), (2, 2.0), (3, 3.0), (1, 1.0)], out)  # 1 late
+    blob = pickle.dumps(lg.state_dict())
+    lg2, out2 = PaneCombineLogic("sum", 3, 1), []
+    lg2.load_state(pickle.loads(blob))
+    feed(lg2, [(i, float(i)) for i in range(4, 8)], out2)
+    lg2.eos_flush(out2.append)
+
+    def collect(rs):
+        return {(r.key, r.id): r.value for r in rs}
+    assert collect(ref_out) == collect(out + out2)
+    assert len(ref_out) == 8  # 6 complete + 2 EOS partials
+
+
 @pytest.mark.parametrize("map_on_tpu", [True, False])
 def test_win_mapreduce_tpu(map_on_tpu):
     def host_fn(gwid, iterable, result):

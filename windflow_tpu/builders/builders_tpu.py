@@ -196,6 +196,7 @@ class PaneFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
         self.value_of = None
         self.device_index = 0
         self.ordered = True
+        self.emit_batches = False
 
     def with_parallelism(self, plq: int, wlq: int = None):
         self.par1 = plq
@@ -214,7 +215,8 @@ class PaneFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                            self.opt_level,
                            max_buffer_elems=self.max_buffer_elems,
                            inflight_depth=self.inflight_depth,
-                           max_batch_delay_ms=self.max_batch_delay_ms)
+                           max_batch_delay_ms=self.max_batch_delay_ms,
+                           emit_batches=self.emit_batches)
 
 
 @_alias_camel

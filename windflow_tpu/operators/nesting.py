@@ -69,6 +69,9 @@ def _clone_inner(inner: InnerOp, idx: int, n_replicas: int,
             plq_on_tpu=inner.plq_on_tpu, wlq_on_tpu=not inner.plq_on_tpu,
             batch_len=inner.batch_len,
             max_buffer_elems=inner.max_buffer_elems,
+            inflight_depth=inner.inflight_depth,
+            max_batch_delay_ms=inner.max_batch_delay_ms,
+            emit_batches=inner.emit_batches,
             triggering_delay=inner.triggering_delay,
             name=f"{inner.name}_{idx}", result_factory=inner.result_factory,
             value_of=inner.value_of, ordered=False,
@@ -78,6 +81,9 @@ def _clone_inner(inner: InnerOp, idx: int, n_replicas: int,
             inner.map_stage, inner.reduce_stage, inner.win_len,
             private_slide, inner.win_type, inner.map_par, inner.reduce_par,
             map_on_tpu=inner.map_on_tpu, batch_len=inner.batch_len,
+            max_buffer_elems=inner.max_buffer_elems,
+            inflight_depth=inner.inflight_depth,
+            max_batch_delay_ms=inner.max_batch_delay_ms,
             triggering_delay=inner.triggering_delay,
             name=f"{inner.name}_{idx}", result_factory=inner.result_factory,
             value_of=inner.value_of, ordered=False, config=cfg)

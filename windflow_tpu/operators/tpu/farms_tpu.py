@@ -191,7 +191,11 @@ class WinFarmTPU(_TPUWinOp):
 
 class PaneFarmTPU(_TPUWinOp):
     """PLQ or WLQ on device (pane_farm_gpu.hpp:105-106): the device stage
-    takes a win_kind, the host stage a Python callable."""
+    takes a win_kind; the host stage takes a Python callable, or -- for
+    a host WLQ -- a builtin name ('sum'/'max'/'min'), which runs the
+    columnar pane->window combine (pane_combine.PaneCombineLogic)
+    instead of the per-record engine.  ``emit_batches`` applies to that
+    columnar WLQ only; callable/device WLQ stages emit records."""
 
     def __init__(self, plq: Any, wlq: Any, win_len, slide_len, win_type,
                  plq_parallelism=1, wlq_parallelism=1, plq_on_tpu=True,
@@ -202,7 +206,8 @@ class PaneFarmTPU(_TPUWinOp):
                  config: WinOperatorConfig = None,
                  max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS,
                  inflight_depth=DEFAULT_INFLIGHT_DEPTH,
-                 max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS):
+                 max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS,
+                 emit_batches=False):
         super().__init__(name, plq_parallelism + wlq_parallelism,
                          RoutingMode.COMPLEX, Pattern.PANE_FARM_TPU,
                          win_type)
@@ -235,22 +240,54 @@ class PaneFarmTPU(_TPUWinOp):
         self.max_buffer_elems = max_buffer_elems
         self.inflight_depth = inflight_depth
         self.max_batch_delay_ms = max_batch_delay_ms
+        self.emit_batches = emit_batches
         # enclosing config: identity standalone, nested arithmetic when
         # replicated inside a Win_Farm/Key_Farm (win_farm_gpu.hpp:73-76)
         self.config = config or WinOperatorConfig(0, 1, slide_len,
                                                   0, 1, slide_len)
+        if plq_on_tpu and isinstance(wlq, str):
+            from .pane_combine import WLQ_KINDS
+            if wlq not in WLQ_KINDS:
+                raise ValueError(
+                    f"host WLQ builtin must be one of "
+                    f"{sorted(WLQ_KINDS)}: {wlq!r}")
+        # a builtin-name WLQ on the host runs the columnar pane->window
+        # combine instead of the per-record engine -- but only under an
+        # identity config: PaneCombineLogic has no id_inner/n_inner
+        # arithmetic, so nested copies (which offset and stripe window
+        # ids per copy) must stay on the stock per-record WLQ
+        cfg = self.config
+        self._wlq_columnar = (plq_on_tpu and isinstance(wlq, str)
+                              and cfg.n_outer == 1 and cfg.n_inner == 1
+                              and cfg.id_outer == 0 and cfg.id_inner == 0)
 
-    def _device_single(self, kind, win, slide, win_type, role, delay):
+    def _device_single(self, kind, win, slide, win_type, role, delay,
+                       emit_batches=False):
         """One device engine replica (shared by the fused path and the
         par-1 stage branches -- the config arithmetic lives here)."""
         return _tpu_replicas(
             kind, win, slide, win_type, 1, batch_len=self.batch_len,
             triggering_delay=delay, result_factory=self.result_factory,
             value_of=self.value_of, enclosing=self.config, role=role,
-            farm_kind="seq",
+            farm_kind="seq", emit_batches=emit_batches,
             max_buffer_elems=self.max_buffer_elems,
             inflight_depth=self.inflight_depth,
             max_batch_delay_ms=self.max_batch_delay_ms)[0]
+
+    def _columnar_wlq(self, wlq_win, wlq_slide):
+        from .pane_combine import PaneCombineLogic
+        return PaneCombineLogic(self.wlq, wlq_win, wlq_slide,
+                                result_factory=self.result_factory,
+                                emit_batches=self.emit_batches)
+
+    def _wlq_fn(self):
+        """The host WLQ as a callable: builtin names map to the stock
+        per-record aggregation (builtin_win_func) so nested copies
+        (non-identity config) can run the per-record engine."""
+        if not isinstance(self.wlq, str):
+            return self.wlq
+        from ..win_seq import builtin_win_func
+        return builtin_win_func(self.wlq)
 
     def _host_single(self, fn, win, slide, win_type, role, delay=0):
         cfg = self.config
@@ -273,9 +310,12 @@ class PaneFarmTPU(_TPUWinOp):
         wlq_slide = self.slide_len // pane
         if self.plq_on_tpu:
             plq = self._device_single(self.plq, pane, pane, self.win_type,
-                                      Role.PLQ, self.triggering_delay)
-            wlq = self._host_single(self.wlq, wlq_win, wlq_slide,
-                                    WinType.CB, Role.WLQ)
+                                      Role.PLQ, self.triggering_delay,
+                                      emit_batches=self._wlq_columnar)
+            wlq = (self._columnar_wlq(wlq_win, wlq_slide)
+                   if self._wlq_columnar
+                   else self._host_single(self._wlq_fn(), wlq_win,
+                                          wlq_slide, WinType.CB, Role.WLQ))
         else:
             plq = self._host_single(self.plq, pane, pane, self.win_type,
                                     Role.PLQ, self.triggering_delay)
@@ -303,6 +343,7 @@ class PaneFarmTPU(_TPUWinOp):
                 result_factory=self.result_factory, value_of=self.value_of,
                 enclosing=cfg, role=Role.PLQ,
                 farm_kind="wf" if self.plq_par > 1 else "seq",
+                emit_batches=self._wlq_columnar and self.plq_par == 1,
                 max_buffer_elems=self.max_buffer_elems,
                 inflight_depth=self.inflight_depth,
                 max_batch_delay_ms=self.max_batch_delay_ms)
@@ -352,10 +393,21 @@ class PaneFarmTPU(_TPUWinOp):
                 ordering_mode=OrderingMode.ID,
                 collector=(WidOrderCollector()
                            if self.wlq_par > 1 and self.ordered else None)))
+        elif self._wlq_columnar:  # host columnar combine (keyed)
+            # keyed sharding sends each key's whole pane stream to one
+            # replica, which fires its windows in wid order -- the same
+            # per-key guarantee the WidOrderCollector gives the
+            # window-sharded stock branches, so no collector is needed
+            reps = [self._columnar_wlq(wlq_win, wlq_slide)
+                    for _ in range(self.wlq_par)]
+            stages.append(StageSpec(
+                f"{self.name}_wlq", reps,
+                StandardEmitter(keyed=True), RoutingMode.KEYBY,
+                ordering_mode=OrderingMode.ID))
         else:  # WLQ on host
             if self.wlq_par > 1:
                 from ..win_farm import WinFarm
-                wlq = WinFarm(self.wlq, wlq_win, wlq_slide, WinType.CB,
+                wlq = WinFarm(self._wlq_fn(), wlq_win, wlq_slide, WinType.CB,
                               self.wlq_par, 0, False, f"{self.name}_wlq",
                               self.result_factory, None, self.ordered,
                               self.opt_level, WinOperatorConfig(
@@ -366,7 +418,7 @@ class PaneFarmTPU(_TPUWinOp):
             else:
                 stages.append(StageSpec(
                     f"{self.name}_wlq",
-                    [self._host_single(self.wlq, wlq_win, wlq_slide,
+                    [self._host_single(self._wlq_fn(), wlq_win, wlq_slide,
                                        WinType.CB, Role.WLQ)],
                     StandardEmitter(keyed=True),
                     RoutingMode.KEYBY, ordering_mode=OrderingMode.ID))

@@ -58,12 +58,11 @@ def restore_graph(graph, path: str) -> int:
         states = pickle.load(f)
     loadable = {}
     for node in graph._all_nodes():
-        if getattr(node.logic, "load_state", None) is None:
-            continue
-        getter = getattr(node.logic, "state_dict", None)
-        if getter is None or getter() is None:
-            continue  # stateless here => stateless in the saved twin
-        loadable[node.name] = node.logic
+        # statefulness is type-structural (every stateful logic returns
+        # a dict unconditionally), so a None probe here means the saved
+        # twin was stateless too
+        if node.logic.state_dict() is not None:
+            loadable[node.name] = node.logic
     extra = set(states) - set(loadable)
     missing = set(loadable) - set(states)
     if extra or missing:
