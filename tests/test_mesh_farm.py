@@ -343,6 +343,132 @@ def test_pane_farm_mesh_kinds(kind):
     assert bad == 0, (bad, len(got))
 
 
+@pytest.mark.parametrize("win_axis", [2, 4, 8])
+@pytest.mark.parametrize("win,slide", [(12, 4), (8, 8)])
+def test_wmr_mesh_matches_oracle(win_axis, win, slide):
+    """WinMapReduceMesh (round-robin stripes + psum over 'win') vs the
+    sequential oracle -- the third mesh distribution as a graph
+    operator."""
+    from windflow_tpu.operators.tpu.wmr_mesh import WinMapReduceMesh
+
+    mesh2 = make_mesh(8, win_axis=win_axis)
+    n_keys, per_key = 6, 48
+    state = {"sent": 0}
+
+    def source(ctx):
+        i = state["sent"]
+        total = n_keys * per_key
+        if i >= total:
+            return None
+        n = min(200, total - i)
+        idx = i + np.arange(n)
+        state["sent"] = i + n
+        return TupleBatch({
+            "key": idx % n_keys,
+            "id": idx // n_keys,
+            "ts": idx // n_keys,
+            "value": (idx // n_keys).astype(np.float64),
+        })
+
+    got = {}
+    lock = threading.Lock()
+
+    def sink(item):
+        if item is None:
+            return
+        with lock:
+            for j in range(len(item)):
+                got.setdefault(int(item.key[j]), {})[
+                    int(item.id[j])] = float(item["value"][j])
+
+    g = wf.PipeGraph("wmr-mesh", Mode.DEFAULT)
+    op = WinMapReduceMesh(mesh2, win, slide, WinType.TB, batch_windows=16)
+    g.add_source(BatchSource(source)).add(op).add_sink(Sink(sink))
+    g.run()
+    assert op.engine.n_win_shards == win_axis
+    expect = oracle(per_key, win, slide)
+    assert set(got) == set(range(n_keys))
+    for k in got:
+        assert got[k] == expect, (k, got[k])
+
+
+@pytest.mark.parametrize("kind", ["count", "max", "min", "ffat"])
+def test_wmr_mesh_kinds_match_oracle(kind):
+    """WinMapReduceMesh beyond sum: pmax/pmin REDUCE collectives for
+    the builtins, all_gather + pairwise combine for FFAT lift+combine
+    (win_mapreduce_gpu.hpp arbitrary functors at mesh scale)."""
+    import jax.numpy as jnp
+    from windflow_tpu.operators.tpu.wmr_mesh import WinMapReduceMesh
+
+    mesh2 = make_mesh(8, win_axis=4)
+    win, slide = 12, 4
+    n_keys, per_key = 5, 40
+    rngs = {k: np.random.default_rng(100 + k).normal(size=per_key)
+            for k in range(n_keys)}
+    state = {"sent": 0}
+
+    def source(ctx):
+        i = state["sent"]
+        total = n_keys * per_key
+        if i >= total:
+            return None
+        n = min(128, total - i)
+        idx = i + np.arange(n)
+        keys, ids = idx % n_keys, idx // n_keys
+        vals = np.empty(n)
+        for k in range(n_keys):
+            m = keys == k
+            vals[m] = rngs[k][ids[m]]
+        state["sent"] = i + n
+        return TupleBatch({"key": keys, "id": ids, "ts": ids,
+                           "value": vals})
+
+    spec = (("ffat", lambda v: np.abs(v), jnp.maximum, float("-inf"))
+            if kind == "ffat" else kind)
+
+    got = {}
+    lock = threading.Lock()
+
+    def sink(item):
+        if item is None:
+            return
+        with lock:
+            for j in range(len(item)):
+                got.setdefault(int(item.key[j]), {})[
+                    int(item.id[j])] = float(item["value"][j])
+
+    g = wf.PipeGraph("wmr-kinds", Mode.DEFAULT)
+    op = WinMapReduceMesh(mesh2, win, slide, WinType.TB, batch_windows=16,
+                          kind=spec)
+    g.add_source(BatchSource(source)).add(op).add_sink(Sink(sink))
+    g.run()
+
+    def expect(seg):
+        if kind == "count":
+            return float(len(seg))
+        if kind == "max":
+            return float(seg.max())
+        if kind == "min":
+            return float(seg.min())
+        return float(np.abs(seg).max())  # ffat: max of |lifted|
+
+    assert set(got) == set(range(n_keys))
+    for k in range(n_keys):
+        g_ = 0
+        while g_ * slide < per_key:
+            seg = rngs[k][g_ * slide: g_ * slide + win]
+            assert abs(got[k][g_] - expect(seg)) < 1e-5 * max(
+                1, abs(expect(seg))), (kind, k, g_)
+            g_ += 1
+
+
+def test_mesh_mean_rejected_on_wmr():
+    from windflow_tpu.operators.tpu.wmr_mesh import WinMapReduceMesh
+    mesh2 = make_mesh(8, win_axis=2)
+    with pytest.raises(ValueError, match="mean"):
+        WinMapReduceMesh(mesh2, 8, 4, WinType.TB, kind="mean")
+
+
 def test_mesh_mean_rejected_on_pane_farm():
     from windflow_tpu.operators.tpu.pane_mesh import PaneFarmMesh
     mesh2 = make_mesh(8, win_axis=2)

@@ -39,6 +39,7 @@ def __getattr__(name):
         # mesh-scale operators + mesh construction (multi-chip plane)
         "KeyFarmMesh": "windflow_tpu.operators.tpu.mesh_farm",
         "PaneFarmMesh": "windflow_tpu.operators.tpu.pane_mesh",
+        "WinMapReduceMesh": "windflow_tpu.operators.tpu.wmr_mesh",
         "WinSeqFFATResident": "windflow_tpu.operators.tpu.ffat_resident",
         "make_mesh": "windflow_tpu.parallel.mesh",
         "make_multihost_mesh": "windflow_tpu.parallel.mesh",

@@ -117,6 +117,38 @@ class KeyFarmMeshLogic(NodeLogic):
         if len(self.ready) >= self.batch_windows:
             self._launch(emit)
 
+    def _involved_keys(self, ready):
+        """Ready windows' keys, first-seen order."""
+        involved, seen = [], set()
+        for key, *_ in ready:
+            if key not in seen:
+                seen.add(key)
+                involved.append(key)
+        return involved
+
+    def _consolidate_key(self, key):
+        """Sort-merge one key's buffered chunks in place; returns the
+        consolidated (ids, vals)."""
+        st = self.keys[key]
+        ids = np.concatenate(st.ids) if st.ids else np.empty(0, np.int64)
+        vals = (np.concatenate(st.vals) if st.vals
+                else np.empty(0, np.float64))
+        order = np.argsort(ids, kind="stable")
+        ids, vals = ids[order], vals[order]
+        st.ids, st.vals = [ids], [vals]
+        return ids, vals
+
+    def _evict_consumed(self, involved):
+        """Drop each key's prefix no window >= next_fire can reach."""
+        for key in involved:
+            st = self.keys[key]
+            keep_from = st.next_fire * self.slide_len
+            ids = st.ids[0]
+            cut = np.searchsorted(ids, keep_from, "left")
+            if cut:
+                st.ids = [ids[cut:]]
+                st.vals = [st.vals[0][cut:]]
+
     def _launch(self, emit):
         if not self.ready:
             return
@@ -126,20 +158,9 @@ class KeyFarmMeshLogic(NodeLogic):
         shard_vals: List[List[np.ndarray]] = [[] for _ in range(S)]
         shard_len = [0] * S
         offsets: Dict[Any, tuple] = {}
-        involved = []
-        seen = set()
-        for key, *_ in ready:
-            if key not in seen:
-                seen.add(key)
-                involved.append(key)
+        involved = self._involved_keys(ready)
         for key in involved:
-            st = self.keys[key]
-            ids = np.concatenate(st.ids) if st.ids else np.empty(0, np.int64)
-            vals = (np.concatenate(st.vals) if st.vals
-                    else np.empty(0, np.float64))
-            order = np.argsort(ids, kind="stable")
-            ids, vals = ids[order], vals[order]
-            st.ids, st.vals = [ids], [vals]
+            ids, vals = self._consolidate_key(key)
             sh = abs(hash(key)) % S
             offsets[key] = (sh, shard_len[sh], ids)
             shard_vals[sh].append(vals)
@@ -185,15 +206,7 @@ class KeyFarmMeshLogic(NodeLogic):
             for key, lwid, sh, slot in placement:
                 r = BasicRecord(key, lwid, 0, float(out[sh, slot]))
                 emit(r)
-        # evict consumed prefixes
-        for key in involved:
-            st = self.keys[key]
-            keep_from = st.next_fire * self.slide_len
-            ids = st.ids[0]
-            cut = np.searchsorted(ids, keep_from, "left")
-            if cut:
-                st.ids = [ids[cut:]]
-                st.vals = [st.vals[0][cut:]]
+        self._evict_consumed(involved)
 
     def eos_flush(self, emit):
         for key, st in self.keys.items():
@@ -214,12 +227,14 @@ class KeyFarmMesh(Operator):
     per-shard device FlatFAT (key_farm_gpu.hpp / key_ffat_gpu.hpp at
     mesh scale)."""
 
+    _logic_cls = KeyFarmMeshLogic
+    _pattern = Pattern.KEY_FARM_TPU
+
     def __init__(self, mesh, win_len: int, slide_len: int,
                  win_type: WinType, batch_windows: int = 1024,
                  name: str = "key_farm_mesh", emit_batches: bool = True,
                  kind="sum"):
-        super().__init__(name, 1, RoutingMode.FORWARD,
-                         Pattern.KEY_FARM_TPU)
+        super().__init__(name, 1, RoutingMode.FORWARD, self._pattern)
         from ...parallel.sharded import ShardedWindowEngine
         self.win_type = win_type
         self.engine = ShardedWindowEngine(mesh, win_len, slide_len, kind)
@@ -228,8 +243,8 @@ class KeyFarmMesh(Operator):
 
     def stages(self):
         win_len, slide_len, win_type, bw, eb = self.args
-        logic = KeyFarmMeshLogic(self.engine, win_len, slide_len, win_type,
-                                 bw, eb)
+        logic = self._logic_cls(self.engine, win_len, slide_len, win_type,
+                                bw, eb)
         return [StageSpec(self.name, [logic], StandardEmitter(),
                           self.routing,
                           ordering_mode=(OrderingMode.ID

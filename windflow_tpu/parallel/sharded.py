@@ -272,23 +272,42 @@ class ShardedWindowEngine:
         return self._ring(jax.device_put(pane_values, sh))
 
     def compute_wmr(self, stripes):
-        """Striped window sums with a psum over 'win' (the Win_MapReduce
+        """Striped window combines over 'win' (the Win_MapReduce
         distribution as a standalone program, used by
-        operators.tpu.mesh_farm.WinMapReduceMesh).
+        operators.tpu.wmr_mesh.WinMapReduceMesh).
 
         ``stripes``: [K_rows, W_shards, B, stripe_len] — window b of row
         k holds its tuples round-robin striped over the 'win' axis
-        (WinMap_Emitter's per-key round robin, wm_nodes.hpp:62).
-        Returns [K_rows, B] full window sums."""
+        (WinMap_Emitter's per-key round robin, wm_nodes.hpp:62), padded
+        with the combine's neutral.  Each chip folds its stripe locally
+        (the MAP stage); the cross-stripe REDUCE rides ICI as a psum /
+        pmax / pmin for the builtins, or an all_gather + log-depth
+        pairwise combine for a custom FFAT fold.  Returns [K_rows, B]
+        full window results."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        if self.kind == "mean":
+            raise ValueError("WinMapReduceMesh does not support 'mean' "
+                             "(stripe partials carry no count channel)")
         if not hasattr(self, "_wmr_only"):
             import jax.numpy as jnp
+            kind, comb, neutral = self.kind, self.combine, self.neutral
 
             def wmr_shard(stripe):
-                partial = jnp.sum(stripe, axis=(-1,))
-                return jax.lax.psum(partial, "win")
+                # [K_loc, 1, B, stripe_len] on this chip
+                if kind in ("sum", "count"):
+                    return jax.lax.psum(jnp.sum(stripe, axis=-1), "win")
+                if kind == "max":
+                    return jax.lax.pmax(jnp.max(stripe, axis=-1), "win")
+                if kind == "min":
+                    return jax.lax.pmin(jnp.min(stripe, axis=-1), "win")
+                partial = pairwise_fold(stripe, comb, neutral, jnp)
+                allp = jax.lax.all_gather(partial, "win", axis=1,
+                                          tiled=True)     # [K_loc, W, B]
+                out = pairwise_fold(jnp.moveaxis(allp, 1, -1), comb,
+                                    neutral, jnp)          # [K_loc, B]
+                return out[:, None, :]
 
             self._wmr_only = jax.jit(jax.shard_map(
                 wmr_shard, mesh=self.mesh,

@@ -60,8 +60,10 @@ def restore_graph(graph, path: str) -> int:
     for node in graph._all_nodes():
         # statefulness is type-structural (every stateful logic returns
         # a dict unconditionally), so a None probe here means the saved
-        # twin was stateless too
-        if node.logic.state_dict() is not None:
+        # twin was stateless too; the getattr mirrors graph_state's
+        # guard for duck-typed logics without the hook
+        getter = getattr(node.logic, "state_dict", None)
+        if getter is not None and getter() is not None:
             loadable[node.name] = node.logic
     extra = set(states) - set(loadable)
     missing = set(loadable) - set(states)
