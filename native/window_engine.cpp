@@ -342,6 +342,76 @@ struct Engine {
         }
     }
 
+    // Fused synthesis + ingest: generate events [start, start+n) of the
+    // declared synthetic law (key = e % K, id = ts = e / K,
+    // value = (e % vmod) * vscale + voff -- operators/synth.py) and
+    // fold them directly into the pane rings.  Grouping by key turns
+    // the per-tuple hash probe into one map lookup per key, and the
+    // generated columns never materialize in memory: the host feed for
+    // a declared synthetic stream costs the fold alone, the columnar
+    // twin of the record plane's set_synth lane.
+    void synth_ingest(i64 start, i64 n, i64 K, i64 vmod,
+                      double vscale, double voff) {
+        const i64 endE = start + n;
+        const bool hopping = win < slide;
+        if (vmod <= 0) vmod = 1;
+        const i64 kmod = K % vmod;
+        for (i64 k = 0; k < K; ++k) {
+            // first event e >= start with e % K == k
+            i64 e0 = start + (((k - start % K) % K) + K) % K;
+            if (e0 >= endE) continue;
+            KeyState& st = keys[k];
+            const i64 id0 = e0 / K;
+            const i64 cnt = (endE - e0 + K - 1) / K;
+            if (st.max_id < 0) {
+                st.anchor = id0 < win ? 0 : (id0 - win) / slide + 1;
+                st.next_fire = st.anchor;
+                st.pane_base = pane_of(st.anchor * slide);
+            }
+            st.arrivals += cnt;  // keep the renumber lane consistent
+            i64 hi_rel = pane_of(id0 + cnt - 1) - st.pane_base;
+            if (hi_rel >= 0) ensure_pane(st, hi_rel);
+            const i64 accept = st.next_fire > st.anchor
+                ? (st.next_fire - 1) * slide + win : st.anchor * slide;
+            i64 vm = e0 % vmod;  // value index, advanced mod-free
+            for (i64 j = 0; j < cnt; ++j) {
+                const i64 id = id0 + j;
+                const double v = (double)vm * vscale + voff;
+                vm += kmod;
+                if (vm >= vmod) vm -= vmod;
+                if (id < accept) {
+                    ++ignored;
+                    continue;
+                }
+                const i64 p = pane_of(id) - st.pane_base;
+                if (p < 0) continue;
+                if (hopping) {
+                    const i64 nn = id / slide;
+                    if (id >= nn * slide + win) continue;  // gap id
+                    if (nn > st.opened_max) st.opened_max = nn;
+                }
+                fold(st, p, v);
+                if (!is_tb && id >= st.plid[p]) {
+                    st.plid[p] = id;
+                    st.plts[p] = id;  // the law sets ts = id
+                }
+            }
+            if (id0 + cnt - 1 > st.max_id) st.max_id = id0 + cnt - 1;
+            if (!hopping) {
+                const i64 last_w = (st.max_id + 1 + slide - 1) / slide - 1;
+                if (last_w > st.opened_max) st.opened_max = last_w;
+            }
+            while (true) {
+                const i64 end = st.next_fire * slide + win;
+                if (st.max_id < end + delay || st.next_fire > st.opened_max)
+                    break;
+                ready.push_back(Desc{k, st.next_fire,
+                                     st.next_fire * slide, end});
+                ++st.next_fire;
+            }
+        }
+    }
+
     // pane accessors tolerant of extents beyond the retained ring
     // (panes outside it hold no tuples by construction)
     inline double pane_at(const KeyState& st, i64 p_abs) const {
@@ -624,6 +694,15 @@ i64 wfn_engine_ingest_f32(void* ep, const i64* keys, const i64* ids,
                           const i64* tss, const float* vals, i64 n) {
     Engine& e = *static_cast<Engine*>(ep);
     e.ingest_batch(keys, ids, tss, vals, n);
+    return (i64)e.ready.size();
+}
+
+// Fused synthesis + ingest of the declared synthetic law; returns the
+// number of ready (fired, unstaged) windows afterwards.
+i64 wfn_engine_synth_ingest(void* ep, i64 start, i64 n, i64 n_keys,
+                            i64 vmod, double vscale, double voff) {
+    Engine& e = *static_cast<Engine*>(ep);
+    e.synth_ingest(start, n, n_keys, vmod, vscale, voff);
     return (i64)e.ready.size();
 }
 

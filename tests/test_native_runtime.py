@@ -242,6 +242,59 @@ def test_engine_renumber_hopping_gap_after_eviction():
     assert vals[starts[0]:ends[0]].sum() == 6.0  # arrivals 10, 11 only
 
 
+@pytest.mark.parametrize("win,slide,kind", [
+    (32, 16, "sum"),    # sliding
+    (16, 16, "max"),    # tumbling
+    (8, 24, "sum"),     # hopping (gap ids dropped)
+])
+def test_engine_synth_ingest_matches_array_ingest(win, slide, kind):
+    """The fused generate+fold lane must stage bit-identical windows to
+    ingesting the same synthetic law as materialized arrays, across
+    chunk splits, geometries, and kinds."""
+    from windflow_tpu.runtime.native import NativeWindowEngine
+
+    N, K, VMOD = 40_000, 7, 97
+
+    def drain(eng, out):
+        while True:
+            r = eng.flush(1 << 20)
+            if r is None:
+                return
+            vals, starts, ends, keys, gwids, rts = r[:6]
+            for b in range(len(starts)):
+                seg = vals[starts[b]:ends[b]]
+                agg = (seg.sum() if kind == "sum"
+                       else (seg.max() if len(seg) else 0.0))
+                out[(keys[b], gwids[b])] = agg
+
+    # reference: array ingest of the same law
+    idx = np.arange(N, dtype=np.int64)
+    keys = idx % K
+    ids = idx // K
+    vals = (idx % VMOD).astype(np.float64)
+    ref_eng = NativeWindowEngine(win, slide, True, 0, False, kind)
+    ref = {}
+    for lo in range(0, N, 7_000):
+        hi = min(lo + 7_000, N)
+        ref_eng.ingest(keys[lo:hi], ids[lo:hi], ids[lo:hi], vals[lo:hi])
+        drain(ref_eng, ref)
+    ref_eng.eos()
+    drain(ref_eng, ref)
+
+    # fused lane: uneven chunk boundaries exercise the per-key ranges
+    eng = NativeWindowEngine(win, slide, True, 0, False, kind)
+    got = {}
+    for lo in range(0, N, 9_999):
+        eng.synth_ingest(lo, min(9_999, N - lo), K, VMOD, 1.0, 0.0)
+        drain(eng, got)
+    eng.eos()
+    drain(eng, got)
+    assert got.keys() == ref.keys() and len(got) > 50
+    for k in got:
+        assert got[k] == ref[k], (k, got[k], ref[k])
+    assert eng.ignored() == ref_eng.ignored()
+
+
 def test_engine_deserialize_rejects_huge_length_field():
     """A corrupted checkpoint blob with an enormous vector-length field
     must fail cleanly, not overflow the bounds check into a multi-GB

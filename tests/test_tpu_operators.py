@@ -296,6 +296,46 @@ def test_pane_farm_tpu_columnar_wlq_batch_output_and_par():
         assert got[k] == pytest.approx(expect, rel=1e-9)
 
 
+@pytest.mark.parametrize("target", ["winseq_tpu", "batch_map",
+                                    "kf_tpu_par3"])
+def test_chunked_synth_source_any_consumer(target):
+    """SynthChunk descriptors must be transparent at every
+    columnar-plane boundary: chunk-aware device engines fold them
+    natively; every other batch consumer (transforms, multi-replica
+    keyed farms behind routing emitters) sees materialized batches
+    with identical content.  (Record-plane host operators don't consume
+    TupleBatch either -- plane adapters are explicit by design.)"""
+    from windflow_tpu.operators.batch_ops import BatchMap
+    from windflow_tpu.operators.synth import SyntheticSource
+
+    def build_ops(g):
+        if target == "winseq_tpu":
+            return [wf.WinSeqTPUBuilder("sum").with_batch(16)
+                    .with_tb_windows(12, 4).build()]
+        if target == "batch_map":
+            # a chunk landing on a plain batch transform materializes
+            return [BatchMap(lambda b: b),
+                    wf.WinSeqTPUBuilder("sum").with_batch(16)
+                    .with_tb_windows(12, 4).build()]
+        return [wf.KeyFarmTPUBuilder("sum").with_parallelism(3)
+                .with_coalesce(False).with_batch(16)
+                .with_tb_windows(12, 4).build()]
+
+    results = {}
+    for chunked in (False, True):
+        coll = Collector()
+        g = wf.PipeGraph("chunks", Mode.DEFAULT)
+        mp = g.add_source(SyntheticSource(6_000, 5, batch=700,
+                                          chunked=chunked))
+        for op in build_ops(g):
+            mp = mp.add(op)
+        mp.add_sink(wf.SinkBuilder(coll).build())
+        g.run()
+        results[chunked] = coll.by_key()
+    assert results[True] == results[False]
+    assert len(results[True]) == 5
+
+
 def test_nested_pane_farm_builtin_wlq_falls_back_to_record_engine():
     """Nested copies carry non-identity configs (striped/offset window
     ids) the columnar WLQ cannot reproduce; a builtin-name WLQ must

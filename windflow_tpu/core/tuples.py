@@ -27,6 +27,39 @@ class WFRecord(Protocol):
         ...
 
 
+class SynthChunk:
+    """A descriptor slice of the declared synthetic law
+    (operators/synth.SyntheticSource): events [start, start + n) with
+    key = e % n_keys, id = ts = e // n_keys,
+    value = (e % vmod) * vscale + voff.
+
+    A stream item like TupleBatch: consumers that own a native engine
+    fold it without materializing the columns; the runtime materializes
+    it transparently at every other plane boundary (RtNode dispatch,
+    multi-destination outlets)."""
+
+    __slots__ = ("start", "n", "n_keys", "vmod", "vscale", "voff")
+
+    def __init__(self, start, n, n_keys, vmod, vscale, voff):
+        self.start = start
+        self.n = n
+        self.n_keys = n_keys
+        self.vmod = vmod
+        self.vscale = vscale
+        self.voff = voff
+
+    def __len__(self):
+        return self.n
+
+    def materialize(self) -> "TupleBatch":
+        idx = self.start + np.arange(self.n)
+        ids = idx // self.n_keys
+        return TupleBatch({
+            "key": idx % self.n_keys, "id": ids, "ts": ids,
+            "value": (idx % self.vmod).astype(np.float64) * self.vscale
+                     + self.voff})
+
+
 class BasicRecord:
     """Convenience record: key/id/ts control fields + a float value.
 

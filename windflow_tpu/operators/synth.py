@@ -20,17 +20,19 @@ import numpy as np
 
 from ..core.basic import Pattern, RoutingMode
 from ..core.context import RuntimeContext
-from ..core.tuples import BasicRecord, TupleBatch
+from ..core.tuples import BasicRecord, SynthChunk, TupleBatch
 from ..runtime.emitters import StandardEmitter
 from ..runtime.node import SourceLoopLogic
 from .base import Operator, StageSpec
 
 
 class _SynthLogic(SourceLoopLogic):
-    def __init__(self, desc, batch: int, emit_batches: bool):
+    def __init__(self, desc, batch: int, emit_batches: bool,
+                 chunked: bool = False):
         self.desc = desc
         self.batch = batch
         self.emit_batches = emit_batches
+        self.chunked = chunked
         self.sent = 0
         self.context = RuntimeContext(1, 0)
 
@@ -40,18 +42,17 @@ class _SynthLogic(SourceLoopLogic):
             if i >= d.n_events:
                 return False
             n = min(self.batch, d.n_events - i)
-            idx = i + np.arange(n)
-            keys = idx % d.n_keys
-            ids = idx // d.n_keys
-            vals = (idx % d.vmod).astype(np.float64) * d.vscale + d.voff
+            chunk = SynthChunk(i, n, d.n_keys, d.vmod, d.vscale, d.voff)
             self.sent = i + n
-            if self.emit_batches:
-                emit(TupleBatch({"key": keys, "id": ids, "ts": ids,
-                                 "value": vals}))
+            if self.chunked:
+                emit(chunk)
+            elif self.emit_batches:
+                emit(chunk.materialize())  # single source of the law
             else:
+                b = chunk.materialize()
                 for j in range(n):
-                    emit(BasicRecord(int(keys[j]), int(ids[j]),
-                                     int(ids[j]), float(vals[j])))
+                    emit(BasicRecord(int(b.key[j]), int(b.id[j]),
+                                     int(b.ts[j]), float(b["value"][j])))
             return True
 
         super().__init__(step)
@@ -69,7 +70,7 @@ class SyntheticSource(Operator):
     def __init__(self, n_events: int, n_keys: int = 1, vmod: int = 97,
                  vscale: float = 1.0, voff: float = 0.0,
                  batch: int = 65536, emit_batches: bool = True,
-                 name: str = "synthetic_source"):
+                 chunked: bool = False, name: str = "synthetic_source"):
         super().__init__(name, 1, RoutingMode.NONE, Pattern.SOURCE)
         self.n_events = n_events
         self.n_keys = max(1, n_keys)
@@ -78,8 +79,13 @@ class SyntheticSource(Operator):
         self.voff = voff
         self.batch = batch
         self.emit_batches = emit_batches
+        # chunked=True ships SynthChunk descriptors instead of columns;
+        # device window stages fold them natively (win_seq_tpu), other
+        # consumers materialize transparently
+        self.chunked = chunked
 
     def stages(self):
         return [StageSpec(self.name,
-                          [_SynthLogic(self, self.batch, self.emit_batches)],
+                          [_SynthLogic(self, self.batch, self.emit_batches,
+                                       self.chunked)],
                           StandardEmitter(), self.routing)]

@@ -29,7 +29,7 @@ import numpy as np
 from ...core.basic import (OrderingMode, Pattern, Role, RoutingMode,
                            WinOperatorConfig, WinType)
 from ...core.meta import default_hash
-from ...core.tuples import BasicRecord, TupleBatch
+from ...core.tuples import BasicRecord, SynthChunk, TupleBatch
 from ...core import win_assign as wa
 from ...ops.window_compute import DeviceBatchHandle, WindowComputeEngine
 from ...runtime.emitters import StandardEmitter
@@ -198,6 +198,9 @@ class _TPUKeyState:
 
 
 class WinSeqTPULogic(NodeLogic):
+    # the runtime hands SynthChunk descriptors through un-materialized
+    accepts_synth_chunks = True
+
     def __init__(self, win_kind: Any, win_len: int, slide_len: int,
                  win_type: WinType, *, batch_len: int = DEFAULT_BATCH_LEN,
                  triggering_delay: int = 0, result_factory=BasicRecord,
@@ -735,6 +738,24 @@ class WinSeqTPULogic(NodeLogic):
     def svc(self, item, channel_id, emit):
         if isinstance(item, TupleBatch):
             self._svc_batch(item, emit)
+            return
+        if isinstance(item, SynthChunk):
+            # declared synthetic stream: the native engine generates and
+            # folds the chunk in one pass (no host column materializes)
+            if self._native is not None:
+                ready = self._native.synth_ingest(
+                    item.start, item.n, item.n_keys, item.vmod,
+                    item.vscale, item.voff)
+                if ready and self._batch_birth is None:
+                    self._batch_birth = _time.perf_counter()
+                self._buffered_since_launch += item.n
+                if ready and (ready >= self.batch_len
+                              or self._buffered_since_launch
+                              >= self.max_buffer_elems
+                              or self._launch_due()):
+                    self._native_launch(emit)
+            else:
+                self._svc_batch(item.materialize(), emit)
             return
         if self._native is not None and not isinstance(item, EOSMarker):
             # route records through the native engine as 1-row columns so

@@ -111,6 +111,10 @@ def get_lib():
         lib.wfn_engine_ingest_f32.argtypes = [
             ctypes.c_void_p, PLL, PLL, PLL,
             ctypes.POINTER(ctypes.c_float), LL]
+        lib.wfn_engine_synth_ingest.restype = LL
+        lib.wfn_engine_synth_ingest.argtypes = [
+            ctypes.c_void_p, LL, LL, LL, LL,
+            ctypes.c_double, ctypes.c_double]
         lib.wfn_engine_ready.restype = LL
         lib.wfn_engine_ready.argtypes = [ctypes.c_void_p]
         lib.wfn_engine_ignored.restype = LL
@@ -436,6 +440,15 @@ class NativeWindowEngine:
             ts.ctypes.data_as(ctypes.POINTER(LL)),
             vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
             len(keys))
+
+    def synth_ingest(self, start: int, n: int, n_keys: int,
+                     vmod: int = 97, vscale: float = 1.0,
+                     voff: float = 0.0) -> int:
+        """Fused generate+fold of the declared synthetic law
+        (operators/synth.py): events [start, start+n) never materialize
+        as host arrays.  Returns the ready-window count."""
+        return self.lib.wfn_engine_synth_ingest(
+            self.ptr, start, n, n_keys, vmod, vscale, voff)
 
     def ready(self) -> int:
         return self.lib.wfn_engine_ready(self.ptr)

@@ -14,6 +14,7 @@ import threading
 import traceback
 from typing import Any, Callable, List, Optional, Sequence
 
+from ..core.tuples import SynthChunk
 from .queues import Channel, CHANNEL_TIMEOUT
 
 
@@ -64,6 +65,10 @@ class ChainedLogic(NodeLogic):
     def __init__(self, a: NodeLogic, b: NodeLogic):
         self.a = a
         self.b = b
+        # the chain accepts synth-chunk descriptors iff its first half
+        # does (the runtime materializes them otherwise)
+        self.accepts_synth_chunks = getattr(a, "accepts_synth_chunks",
+                                            False)
         # delegate idle ticks only when a half defines them: RtNode
         # probes hasattr, and unconditional definition would put every
         # fused map chain on timed gets for nothing
@@ -143,6 +148,11 @@ class Outlet:
         ch.put(pid, item)
 
     def send(self, item: Any) -> None:
+        if len(self.dests) > 1 and isinstance(item, SynthChunk):
+            # routing emitters read key/id columns: materialize the
+            # descriptor before fan-out (single-destination outlets
+            # pass it through; the consuming node decides there)
+            item = item.materialize()
         self.emitter.emit(item, self.send_to)
 
     def flush_eos(self) -> None:
@@ -225,6 +235,8 @@ class RtNode(threading.Thread):
                 # launches on stalled streams) take timed gets so the
                 # tick fires without input
                 tick = getattr(self.logic, "idle_tick", None)
+                accepts_chunks = getattr(self.logic,
+                                         "accepts_synth_chunks", False)
                 while True:
                     got = (self.channel.get(timeout=0.025) if tick
                            else self.channel.get())
@@ -236,6 +248,8 @@ class RtNode(threading.Thread):
                     if got is None:
                         break
                     cid, item = got
+                    if not accepts_chunks and isinstance(item, SynthChunk):
+                        item = item.materialize()  # plane boundary
                     self.taken += 1
                     if stats is not None:
                         import time as _time

@@ -24,6 +24,21 @@ from typing import Any, Dict, List
 from ..graph.pipegraph import NodeFailureError
 
 
+def _is_stateful(logic) -> bool:
+    """Structural statefulness probe: True iff the logic's class
+    overrides NodeLogic.state_dict (so the saved twin produced state).
+    Avoids calling state_dict(), which serializes the full store just
+    to test for None.  ChainedLogic defers to its halves (its own
+    override returns None when both are stateless)."""
+    from ..runtime.node import ChainedLogic, NodeLogic
+    if isinstance(logic, ChainedLogic):
+        return _is_stateful(logic.a) or _is_stateful(logic.b)
+    fn = getattr(type(logic), "state_dict", None)
+    if fn is None:  # duck-typed logic: the instance hook decides
+        return getattr(logic, "state_dict", None) is not None
+    return fn is not NodeLogic.state_dict
+
+
 def graph_state(graph) -> Dict[str, Any]:
     """Collect every replica's state_dict, keyed by node name."""
     out = {}
@@ -58,12 +73,7 @@ def restore_graph(graph, path: str) -> int:
         states = pickle.load(f)
     loadable = {}
     for node in graph._all_nodes():
-        # statefulness is type-structural (every stateful logic returns
-        # a dict unconditionally), so a None probe here means the saved
-        # twin was stateless too; the getattr mirrors graph_state's
-        # guard for duck-typed logics without the hook
-        getter = getattr(node.logic, "state_dict", None)
-        if getter is not None and getter() is not None:
+        if _is_stateful(node.logic):
             loadable[node.name] = node.logic
     extra = set(states) - set(loadable)
     missing = set(loadable) - set(states)
