@@ -455,10 +455,14 @@ def main():
     configs["2f_win_seq_tpu_feed"] = {
         "rate": round(rate2f, 1), "windows": w2f,
         "vs_baseline": _vs(rate2f)}
+    # configs 3/4 run the same workload as the baseline, so they carry
+    # vs_baseline too; 5/6 are different workloads (no ratio)
     rate3, w3 = run_pane_farm_tpu(16_000_000)
-    configs["3_pane_farm_tpu"] = {"rate": round(rate3, 1), "windows": w3}
+    configs["3_pane_farm_tpu"] = {"rate": round(rate3, 1), "windows": w3,
+                                  "vs_baseline": _vs(rate3)}
     rate4, w4 = run_key_farm_tpu(16_000_000)
-    configs["4_key_farm_tpu"] = {"rate": round(rate4, 1), "windows": w4}
+    configs["4_key_farm_tpu"] = {"rate": round(rate4, 1), "windows": w4,
+                                 "vs_baseline": _vs(rate4)}
     rate5, w5 = run_yahoo(16_000_000)
     configs["5_yahoo_wmr"] = {"rate": round(rate5, 1), "windows": w5}
     for q in ("q5", "q7"):

@@ -103,6 +103,17 @@ def test_tracing_counts_inputs(tmp_path):
     files = list(tmp_path.iterdir())
     assert any(f.suffix == ".json" for f in files)
     assert any(f.suffix == ".dot" for f in files)
+    # rendered diagram artifact (the reference dumps a PDF/SVG over the
+    # wire, pipegraph.hpp:683-709): well-formed XML with one box per
+    # operator in the chain
+    import xml.etree.ElementTree as ET
+    svg = next(f for f in files if f.suffix == ".svg")
+    root = ET.fromstring(svg.read_text())
+    ns = "{http://www.w3.org/2000/svg}"
+    boxes = root.findall(f"{ns}rect")
+    texts = [t.text for t in root.findall(f"{ns}text")]
+    assert len(boxes) >= 3  # source + map + sink at minimum
+    assert any("map" in (t or "") for t in texts)
 
 
 def test_dashboard_protocol(tmp_path):
@@ -113,7 +124,8 @@ def test_dashboard_protocol(tmp_path):
     g = small_graph(cfg)
     g.run()
     dash.join(timeout=5)
-    assert dash.diagram is not None and "digraph" in dash.diagram
+    assert dash.diagram is not None
+    assert dash.diagram.lstrip().startswith("<svg")
     assert dash.deregistered
     assert dash.reports, "at least one 1 Hz report"
     assert dash.reports[-1]["PipeGraph_name"] == "traced"
@@ -210,7 +222,7 @@ def test_dashboard_http_webui(tmp_path):
             if not app["active"] or time.time() > deadline:
                 break
             time.sleep(0.05)
-        assert "digraph" in app["diagram"]
+        assert app["diagram"].lstrip().startswith("<svg")
         assert app["report"]["PipeGraph_name"] == "traced"
         assert not app["active"], "graph deregistered at wait_end"
     finally:

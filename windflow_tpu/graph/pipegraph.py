@@ -245,10 +245,11 @@ class PipeGraph:
             json.dump({"graph": self.name, "channels": rows}, f, indent=1)
 
     def _dump_logs(self) -> None:
-        """Write per-graph stats JSON + graphviz DOT under log_dir
-        (pipegraph.hpp:683-709 dumps <pid>_<op>.json + a PDF diagram)."""
+        """Write per-graph stats JSON + graphviz DOT + a rendered SVG
+        diagram under log_dir (pipegraph.hpp:683-709 dumps
+        <pid>_<op>.json + a PDF/SVG diagram)."""
         import os
-        from ..monitoring.monitor import graph_to_dot
+        from ..monitoring.monitor import graph_to_dot, graph_to_svg
         d = self.config.log_dir
         os.makedirs(d, exist_ok=True)
         pid = os.getpid()
@@ -256,6 +257,8 @@ class PipeGraph:
             f.write(self.stats.to_json(self.get_num_dropped_tuples()))
         with open(os.path.join(d, f"{pid}_{self.name}.dot"), "w") as f:
             f.write(graph_to_dot(self))
+        with open(os.path.join(d, f"{pid}_{self.name}.svg"), "w") as f:
+            f.write(graph_to_svg(self))
 
     def run(self) -> None:
         if not self._started:

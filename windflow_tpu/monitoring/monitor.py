@@ -11,9 +11,10 @@ the same framed protocol:
 * type 2: deregisterApp  [int32 type][int32 app_id][int32 0]  (:288-313)
 
 Integers are little-endian int32 (the reference sends raw host-order
-ints from x86).  The graph diagram is emitted as graphviz DOT (the
-reference sends an SVG rendered via libgvc; DOT is the renderer-free
-equivalent carrying the same topology -- multipipe.hpp:522-591).
+ints from x86).  The registerApp payload is an SVG diagram, as the
+reference renders via libgvc (:243) -- here produced by the pure-python
+``graph_to_svg`` (no graphviz binary); ``graph_to_dot`` still provides
+the DOT text for the log-dir artifact dump (multipipe.hpp:522-591).
 """
 from __future__ import annotations
 
@@ -41,6 +42,49 @@ def graph_to_dot(graph) -> str:
     return "\n".join(lines)
 
 
+def graph_to_svg(graph) -> str:
+    """Pure-python SVG render of the PipeGraph topology -- the diagram
+    artifact twin of the reference's graphviz PDF/SVG dump
+    (pipegraph.hpp:683-709) without an external graphviz binary.
+    Layout: one row per MultiPipe, operators left to right."""
+    BOX_W, BOX_H, GAP_X, GAP_Y, PAD = 148, 40, 42, 26, 16
+    rows = [list(pipe._op_names) for pipe in graph.pipes]
+    if not rows:
+        rows = [[]]
+    width = PAD * 2 + max((len(r) for r in rows), default=0) * \
+        (BOX_W + GAP_X) - (GAP_X if any(rows) else 0)
+    height = PAD * 2 + len(rows) * (BOX_H + GAP_Y) - GAP_Y
+    out = [f'<svg xmlns="http://www.w3.org/2000/svg" '
+           f'width="{max(width, 60)}" height="{max(height, 60)}" '
+           f'font-family="monospace" font-size="11">',
+           f'<title>{_xml(graph.name)}</title>']
+    for ri, names in enumerate(rows):
+        y = PAD + ri * (BOX_H + GAP_Y)
+        for ci, name in enumerate(names):
+            x = PAD + ci * (BOX_W + GAP_X)
+            out.append(
+                f'<rect x="{x}" y="{y}" width="{BOX_W}" height="{BOX_H}"'
+                f' rx="6" fill="#eef3fa" stroke="#47618a"/>')
+            label = name if len(name) <= 20 else name[:19] + "…"
+            out.append(f'<text x="{x + BOX_W / 2}" y="{y + BOX_H / 2 + 4}"'
+                       f' text-anchor="middle">{_xml(label)}</text>')
+            if ci:
+                ax = x - GAP_X
+                out.append(
+                    f'<line x1="{ax}" y1="{y + BOX_H / 2}" x2="{x - 6}"'
+                    f' y2="{y + BOX_H / 2}" stroke="#47618a"/>'
+                    f'<polygon points="{x - 6},{y + BOX_H / 2 - 4} '
+                    f'{x},{y + BOX_H / 2} {x - 6},{y + BOX_H / 2 + 4}"'
+                    f' fill="#47618a"/>')
+    out.append("</svg>")
+    return "\n".join(out)
+
+
+def _xml(s: str) -> str:
+    return (s.replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;").replace('"', "&quot;"))
+
+
 class MonitoringThread(threading.Thread):
     """1 Hz stats reporter (monitoring.hpp:162-314)."""
 
@@ -64,7 +108,7 @@ class MonitoringThread(threading.Thread):
         try:
             self.sock = socket.create_connection(
                 (self.machine, self.port), timeout=2.0)
-            diagram = graph_to_dot(self.graph).encode()
+            diagram = graph_to_svg(self.graph).encode()
             self._send_frame(struct.pack("<ii", 0, len(diagram)), diagram)
             ack = self.sock.recv(4)
             if len(ack) == 4:

@@ -239,7 +239,7 @@ function render(apps) {
           ${fmt(num(rep.Memory_usage_KB) * 1024)}B</div>
           <div class="k">resident memory</div></div>
       </div>
-      ${topoSvg(parseDot(a.diagram))}
+      ${a.diagram.trim().startsWith("<svg") ? a.diagram : topoSvg(parseDot(a.diagram))}
       <div class="spark-wrap">${sparkline(id, hist[id])}</div>
       <table><thead><tr><th>operator</th><th>par</th><th>in</th>
         <th>out</th><th>ignored</th><th>svc &micro;s</th>
