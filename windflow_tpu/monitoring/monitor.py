@@ -81,8 +81,8 @@ def graph_to_svg(graph) -> str:
 
 
 def _xml(s: str) -> str:
-    return (s.replace("&", "&amp;").replace("<", "&lt;")
-            .replace(">", "&gt;").replace('"', "&quot;"))
+    import html
+    return html.escape(s, quote=True)
 
 
 class MonitoringThread(threading.Thread):

@@ -104,6 +104,14 @@ const fmt = v => { const n = num(v);
 const esc = s => String(s).replace(/[&<>"']/g, c => ({"&": "&amp;",
   "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;"}[c]));
 
+function svgImg(svg) {
+  // foreign SVG payloads render as an <img> data URI: an image context
+  // never executes scripts or event handlers, unlike raw injection
+  const b64 = btoa(unescape(encodeURIComponent(svg)));
+  return `<img class="topo" alt="topology" ` +
+         `src="data:image/svg+xml;base64,${b64}">`;
+}
+
 function parseDot(src) {
   const nodes = [], labels = {}, edges = [];
   for (const line of (src || "").split("\\n")) {
@@ -239,7 +247,7 @@ function render(apps) {
           ${fmt(num(rep.Memory_usage_KB) * 1024)}B</div>
           <div class="k">resident memory</div></div>
       </div>
-      ${a.diagram.trim().startsWith("<svg") ? a.diagram : topoSvg(parseDot(a.diagram))}
+      ${a.diagram.trim().startsWith("<svg") ? svgImg(a.diagram) : topoSvg(parseDot(a.diagram))}
       <div class="spark-wrap">${sparkline(id, hist[id])}</div>
       <table><thead><tr><th>operator</th><th>par</th><th>in</th>
         <th>out</th><th>ignored</th><th>svc &micro;s</th>

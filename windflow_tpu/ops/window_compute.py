@@ -247,18 +247,19 @@ _PALLAS_FFAT_MAX_T = 1 << 19
 
 
 def _use_pallas_ffat(t_pad: int) -> bool:
-    """Pallas FFAT query gate: env override, else on for the TPU
-    backend (interpret mode on CPU is slower than the XLA query) for
-    trees that fit VMEM."""
+    """Pallas FFAT query gate: env opt-in only.  The A/B on the real
+    chip (docs/PARITY.md "Pallas vs XLA") measured the bit-walk kernel
+    at parity with the XLA query for short extents and up to 5.5x
+    BEHIND at the extents the engine actually produces for custom
+    combines (extent ~ win_len: no pane pre-reduction there), so the
+    default is the XLA path on every backend."""
     import os
     flag = os.environ.get("WINDFLOW_PALLAS_FFAT", "auto")
-    if flag in ("0", "off"):
-        return False
     if flag in ("1", "on"):
-        return True
-    jax, _ = _jax()
-    return (jax.default_backend() == "tpu"
-            and t_pad <= _PALLAS_FFAT_MAX_T)
+        # honored on every backend (interpret mode off-TPU keeps the
+        # kernel testable on CPU CI), VMEM cap still applies
+        return t_pad <= _PALLAS_FFAT_MAX_T
+    return False
 
 
 # (t_pad, b_pad) shapes whose pallas lowering failed; those shapes fall
