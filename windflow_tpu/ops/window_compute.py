@@ -256,9 +256,10 @@ def _use_pallas_ffat(t_pad: int) -> bool:
     import os
     flag = os.environ.get("WINDFLOW_PALLAS_FFAT", "auto")
     if flag in ("1", "on"):
-        # honored on every backend (interpret mode off-TPU keeps the
-        # kernel testable on CPU CI), VMEM cap still applies
-        return t_pad <= _PALLAS_FFAT_MAX_T
+        # honored unconditionally on every backend (interpret mode
+        # off-TPU keeps the kernel testable on CPU CI; an oversized
+        # tree fails loudly into the per-shape XLA fallback)
+        return True
     return False
 
 
