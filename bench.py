@@ -187,9 +187,10 @@ class _WindowLatencySink:
 
 
 def _chunk_source(n_events, sb=SOURCE_BATCH, stamps=None):
-    """SynthChunk descriptor source shared by the headline and farm
-    configs.  ``stamps`` (optional list) records each chunk's emit time
-    for the window-latency sink.  Offsets derive from shared state:
+    """SynthChunk descriptor source for the stamped headline configs
+    (the farm configs use the library SyntheticSource(chunked=True)
+    directly).  ``stamps`` records each chunk's emit time for the
+    window-latency sink.  Offsets derive from shared state:
     single-replica only."""
     from windflow_tpu.operators.synth import SynthChunk
     assert SOURCE_PARALLELISM == 1, "_chunk_source is not partitioned"
@@ -278,8 +279,8 @@ def run_pane_farm_tpu(n_events):
     under GIL contention and capped the farm below the baseline."""
     import windflow_tpu as wf
     from windflow_tpu.core.basic import OptLevel
-    from windflow_tpu.operators.batch_ops import BatchSource
     from windflow_tpu.operators.basic_ops import Sink
+    from windflow_tpu.operators.synth import SyntheticSource
     from windflow_tpu.operators.tpu.farms_tpu import PaneFarmTPU
 
     sink = _CountSink()
@@ -289,8 +290,8 @@ def run_pane_farm_tpu(n_events):
                      batch_len=DEVICE_BATCH, max_buffer_elems=MAX_BUFFER,
                      inflight_depth=INFLIGHT, opt_level=OptLevel.LEVEL2,
                      emit_batches=True)
-    g.add_source(BatchSource(_chunk_source(n_events),
-                             SOURCE_PARALLELISM)) \
+    g.add_source(SyntheticSource(n_events, N_KEYS, batch=SOURCE_BATCH,
+                                 chunked=True)) \
         .add(op).add_sink(Sink(sink))
     t0 = time.perf_counter()
     g.run()
@@ -303,8 +304,8 @@ def run_key_farm_tpu(n_events, par=2):
     one chip (key_farm_gpu.hpp; the multi-chip version is the mesh
     operator, exercised by dryrun_multichip)."""
     import windflow_tpu as wf
-    from windflow_tpu.operators.batch_ops import BatchSource
     from windflow_tpu.operators.basic_ops import Sink
+    from windflow_tpu.operators.synth import SyntheticSource
     from windflow_tpu.operators.tpu.farms_tpu import KeyFarmTPU
 
     sink = _CountSink()
@@ -312,8 +313,8 @@ def run_key_farm_tpu(n_events, par=2):
     op = KeyFarmTPU("sum", WIN, SLIDE, wf.WinType.TB, parallelism=par,
                     batch_len=DEVICE_BATCH, emit_batches=True,
                     max_buffer_elems=MAX_BUFFER, inflight_depth=INFLIGHT)
-    g.add_source(BatchSource(_chunk_source(n_events),
-                             SOURCE_PARALLELISM)) \
+    g.add_source(SyntheticSource(n_events, N_KEYS, batch=SOURCE_BATCH,
+                                 chunked=True)) \
         .add(op).add_sink(Sink(sink))
     t0 = time.perf_counter()
     g.run()

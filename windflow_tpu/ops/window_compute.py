@@ -256,9 +256,18 @@ def _use_pallas_ffat(t_pad: int) -> bool:
     import os
     flag = os.environ.get("WINDFLOW_PALLAS_FFAT", "auto")
     if flag in ("1", "on"):
-        # honored unconditionally on every backend (interpret mode
-        # off-TPU keeps the kernel testable on CPU CI; an oversized
-        # tree fails loudly into the per-shape XLA fallback)
+        # honored on every backend (interpret mode off-TPU keeps the
+        # kernel testable on CPU CI); the VMEM cap still applies, but
+        # vetoing an explicit opt-in is said out loud -- execution-time
+        # failures of an oversized tree would surface asynchronously,
+        # outside the per-shape fallback's reach
+        if t_pad > _PALLAS_FFAT_MAX_T:
+            import warnings
+            warnings.warn(
+                f"WINDFLOW_PALLAS_FFAT=1 ignored for t_pad={t_pad} "
+                f"(> {_PALLAS_FFAT_MAX_T}: tree would exceed VMEM); "
+                f"using the XLA query", RuntimeWarning, stacklevel=3)
+            return False
         return True
     return False
 

@@ -60,6 +60,7 @@ def make_multihost_mesh(win_axis: int = 1,
     n_procs = jax.process_count()
     if n_procs == 1:
         return make_mesh(win_axis=win_axis, axis_names=axis_names)
+    import numpy as np
     from jax.experimental import mesh_utils
     from jax.sharding import Mesh
 
@@ -67,12 +68,25 @@ def make_multihost_mesh(win_axis: int = 1,
     if local % win_axis != 0:
         raise ValueError(
             f"{local} local devices not divisible by win_axis={win_axis}")
-    # hybrid mesh: first axis split across hosts (DCN), second within
-    # (ICI); axis order matches (key, win)
-    dev_mesh = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=(local // win_axis, win_axis),
-        dcn_mesh_shape=(n_procs, 1),
-    )
+    n_slices = len({getattr(d, "slice_index", None)
+                    for d in jax.devices()})
+    if n_slices == n_procs:
+        # hybrid mesh: first axis split across hosts (DCN), second
+        # within (ICI); axis order matches (key, win).  Genuine
+        # topology errors propagate -- only the no-slice-topology case
+        # below uses the process-grouped layout.
+        dev_mesh = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(local // win_axis, win_axis),
+            dcn_mesh_shape=(n_procs, 1),
+        )
+    else:
+        # no per-process slice topology exposed (e.g. the forced-host-
+        # platform CPU backend of the 2-process DCN exercise): group
+        # devices by process so every 'win' row stays inside one
+        # process -- the same locality the hybrid mesh provides
+        devs = sorted(jax.devices(),
+                      key=lambda d: (d.process_index, d.id))
+        dev_mesh = np.array(devs).reshape(-1, win_axis)
     return Mesh(dev_mesh, axis_names)
 
 
