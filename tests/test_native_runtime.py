@@ -242,15 +242,22 @@ def test_engine_renumber_hopping_gap_after_eviction():
     assert vals[starts[0]:ends[0]].sum() == 6.0  # arrivals 10, 11 only
 
 
-@pytest.mark.parametrize("win,slide,kind", [
-    (32, 16, "sum"),    # sliding
-    (16, 16, "max"),    # tumbling
-    (8, 24, "sum"),     # hopping (gap ids dropped)
+@pytest.mark.parametrize("win,slide,kind,start,delay,vscale,voff", [
+    (32, 16, "sum", 0, 0, 1.0, 0.0),    # sliding
+    (16, 16, "max", 0, 0, 1.0, 0.0),    # tumbling
+    (8, 24, "sum", 0, 0, 1.0, 0.0),     # hopping (gap ids dropped)
+    (1, 1, "sum", 0, 0, 1.0, 0.0),      # degenerate single-id windows
+    (32, 16, "sum", 30_000, 0, 1.0, 0.0),   # mid-stream start: anchor
+    (32, 16, "sum", 0, 40, 1.0, 0.0),       # TB triggering delay
+    (16, 8, "min", 0, 0, -2.5, 7.0),        # value law scale/offset
 ])
-def test_engine_synth_ingest_matches_array_ingest(win, slide, kind):
+def test_engine_synth_ingest_matches_array_ingest(win, slide, kind,
+                                                  start, delay, vscale,
+                                                  voff):
     """The fused generate+fold lane must stage bit-identical windows to
     ingesting the same synthetic law as materialized arrays, across
-    chunk splits, geometries, and kinds."""
+    chunk splits, geometries, kinds, anchored mid-stream starts,
+    triggering delay, and the value law's scale/offset."""
     from windflow_tpu.runtime.native import NativeWindowEngine
 
     N, K, VMOD = 40_000, 7, 97
@@ -264,15 +271,17 @@ def test_engine_synth_ingest_matches_array_ingest(win, slide, kind):
             for b in range(len(starts)):
                 seg = vals[starts[b]:ends[b]]
                 agg = (seg.sum() if kind == "sum"
-                       else (seg.max() if len(seg) else 0.0))
+                       else (seg.max() if kind == "max" and len(seg)
+                             else (seg.min() if len(seg) else 0.0)))
                 out[(keys[b], gwids[b])] = agg
 
-    # reference: array ingest of the same law
-    idx = np.arange(N, dtype=np.int64)
+    # reference: array ingest of the same law over events
+    # [start, start + N)
+    idx = start + np.arange(N, dtype=np.int64)
     keys = idx % K
     ids = idx // K
-    vals = (idx % VMOD).astype(np.float64)
-    ref_eng = NativeWindowEngine(win, slide, True, 0, False, kind)
+    vals = (idx % VMOD).astype(np.float64) * vscale + voff
+    ref_eng = NativeWindowEngine(win, slide, True, delay, False, kind)
     ref = {}
     for lo in range(0, N, 7_000):
         hi = min(lo + 7_000, N)
@@ -282,10 +291,11 @@ def test_engine_synth_ingest_matches_array_ingest(win, slide, kind):
     drain(ref_eng, ref)
 
     # fused lane: uneven chunk boundaries exercise the per-key ranges
-    eng = NativeWindowEngine(win, slide, True, 0, False, kind)
+    eng = NativeWindowEngine(win, slide, True, delay, False, kind)
     got = {}
-    for lo in range(0, N, 9_999):
-        eng.synth_ingest(lo, min(9_999, N - lo), K, VMOD, 1.0, 0.0)
+    for lo in range(start, start + N, 9_999):
+        eng.synth_ingest(lo, min(9_999, start + N - lo), K, VMOD,
+                         vscale, voff)
         drain(eng, got)
     eng.eos()
     drain(eng, got)

@@ -145,9 +145,13 @@ def test_multihost_mesh_two_process_dcn_exercise(tmp_path):
     import subprocess
     import sys
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    # hold the port with SO_REUSEADDR until the workers launch: a
+    # bind-close-reuse gap would let another process steal it and fail
+    # the test with an unrelated timeout
+    holder = socket.socket()
+    holder.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    holder.bind(("127.0.0.1", 0))
+    port = holder.getsockname()[1]
     script = tmp_path / "worker.py"
     script.write_text(_MULTIHOST_WORKER)
     env = {k: v for k, v in os.environ.items()
@@ -158,6 +162,8 @@ def test_multihost_mesh_two_process_dcn_exercise(tmp_path):
         [sys.executable, str(script), str(i), str(port)],
         env=env, cwd=root, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True) for i in (0, 1)]
+    holder.close()  # workers are racing for it now; SO_REUSEADDR lets
+    #                 the coordinator bind while this socket lingers
     import time as _time
     deadline = _time.monotonic() + 150
     outs = ["", ""]
