@@ -174,6 +174,34 @@ def test_three_way_split_one_branch_sinks_others_merge():
     assert final.total == 2 * sum(r0) + 2 * sum(r1)
 
 
+def test_partial_merge_of_split_subset_continues_in_structure():
+    """Partial merge: a 4-way split whose MIDDLE two siblings merge
+    into a pipe that keeps processing (map stage), while the outer two
+    siblings merge separately; the two merged structures then merge
+    into the final sink -- the merge-partial shape of
+    pipegraph.hpp:331-503 (a subset of siblings re-joining the
+    enclosing structure) rather than a full or independent merge."""
+    n = 160
+    sink = SumSink()
+    g = wf.PipeGraph("partial-merge", Mode.DEFAULT)
+    pipe = g.add_source(wf.SourceBuilder(source_fn(n)).build())
+    pipe.split(lambda t: int(t.value) % 4, 4)
+
+    def triple(t):
+        t.value *= 3.0
+
+    mid = pipe.select(1).merge(pipe.select(2))   # subset {1, 2}
+    mid.add(wf.MapBuilder(triple).build())       # ...and keeps going
+    outer = pipe.select(0).merge(pipe.select(3))  # subset {0, 3}
+    final = mid.merge(outer)                     # merge of merges
+    final.add_sink(wf.SinkBuilder(sink).build())
+    g.run()
+    mids = sum(v for v in range(n) if v % 4 in (1, 2))
+    outers = sum(v for v in range(n) if v % 4 in (0, 3))
+    assert sink.total == 3 * mids + outers, sink.total
+    assert sink.count == n
+
+
 def test_nested_split_inside_branch():
     """Split inside a split branch (graph_tests test_graph_5/7 style):
     outer split by %2, branch 1 splits again by %4, all leaves sink."""

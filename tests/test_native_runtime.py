@@ -268,12 +268,11 @@ def test_engine_synth_ingest_matches_array_ingest(win, slide, kind,
             if r is None:
                 return
             vals, starts, ends, keys, gwids, rts = r[:6]
+            agg_of = {"sum": np.sum, "max": np.max, "min": np.min}[kind]
             for b in range(len(starts)):
                 seg = vals[starts[b]:ends[b]]
-                agg = (seg.sum() if kind == "sum"
-                       else (seg.max() if kind == "max" and len(seg)
-                             else (seg.min() if len(seg) else 0.0)))
-                out[(keys[b], gwids[b])] = agg
+                out[(keys[b], gwids[b])] = (agg_of(seg) if len(seg)
+                                            else 0.0)
 
     # reference: array ingest of the same law over events
     # [start, start + N)
