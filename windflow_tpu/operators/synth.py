@@ -57,6 +57,13 @@ class _SynthLogic(SourceLoopLogic):
 
         super().__init__(step)
 
+    # -- checkpoint: a declared source resumes from its offset ---------
+    def state_dict(self):
+        return {"sent": self.sent}
+
+    def load_state(self, state) -> None:
+        self.sent = state["sent"]
+
 
 class SyntheticSource(Operator):
     """Descriptor source: key=i%K, id=ts=i//K, value=(i%vmod)*vscale+voff.
