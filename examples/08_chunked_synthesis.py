@@ -43,6 +43,9 @@ def run(n, chunked):
 
 def main():
     n = scale(16_000_000)
+    run(max(1000, n // 10), chunked=False)  # warm-up: backend init +
+    #                                         XLA compile must not bias
+    #                                         the first timed run
     dt_mat, s_mat = run(n, chunked=False)
     dt_chk, s_chk = run(n, chunked=True)
     assert s_chk.count == s_mat.count and s_chk.total == s_mat.total, \
