@@ -249,7 +249,12 @@ def run_win_seq_tpu(n_events, source_batch=None, delay_ms=10.0,
 
 def run_cpu_chain(n_events):
     """Config #1: declared map->filter->keyed window chain on the host
-    plane; graph lowering fuses it onto the native record pipeline."""
+    plane.  Graph lowering folds the declared chain into the columnar
+    C++ engine's synthesis law (affine maps compose into the law,
+    value-predicate filters fold to a residue mask --
+    graph/native_lowering.py), so the whole CPU-only chain runs as one
+    fused generate+filter+fold loop; chains the fold cannot express
+    drop to the record pipeline."""
     import windflow_tpu as wf
     from windflow_tpu.core import F
     from windflow_tpu.operators.basic_ops import Filter, Map, Sink

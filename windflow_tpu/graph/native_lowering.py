@@ -113,25 +113,154 @@ def _lower_plan(graph) -> Optional[dict]:
     return plan
 
 
+def _window_geometry(w):
+    """(win_len, slide_len, is_tb) of a declared window operator --
+    WinSeq keeps them in kwargs, KeyFarm as attributes."""
+    win_type = w.win_type
+    is_tb = (win_type == WinType.TB if isinstance(win_type, WinType)
+             else bool(win_type))
+    if hasattr(w, "kwargs"):
+        return w.kwargs["win_len"], w.kwargs["slide_len"], is_tb
+    return w.win_len, w.slide_len, is_tb
+
+
+def _columnar_synth_spec(plan):
+    """Fold a declared SyntheticSource chain into the columnar engine's
+    synthesis law: affine value-maps compose into (vscale, voff), and
+    value-predicate filters fold to a residue MASK -- the synthetic
+    value of event e depends only on e % vmod, so each predicate is
+    decidable per residue at plan time.  Returns (mask|None, vtab)
+    when the whole chain folds, else None (record-plane fallback).
+    ``vtab`` is the per-residue value table computed by applying the
+    map chain SEQUENTIALLY -- bit-identical floats to the per-event
+    record plane, where composing the affines into one (scale, offset)
+    pair could differ by ULPs exactly at filter boundaries.
+
+    A window whose tuples are ALL filtered out never opens on the
+    record plane, while the masked engine would fire it empty, so
+    masks are only accepted when every FULL window provably contains
+    an unmasked tuple: win_len must cover a full residue cycle and
+    every per-key residue class must keep at least one unmasked
+    residue.  (The EOS tail window needs no extra proof: the engine
+    advances triggering only on surviving tuples, so an all-masked
+    tail never opens -- matching the record plane.)"""
+    import math
+
+    import numpy as np
+
+    w = plan["window"]
+    if w.win_kind_name not in ("sum", "count", "mean"):
+        return None  # max/min finalization stays on the record plane
+    src = plan["source"][1]
+    vmod = src.vmod
+    # per-residue values, evolved SEQUENTIALLY through the map chain
+    # (mirrors the record plane's per-event float ops bit for bit)
+    vals = np.arange(vmod, dtype=np.float64) * src.vscale + src.voff
+    mask = None
+    for mk, m in plan["middles"]:
+        if mk == "map":
+            field, scale, offset, square = m
+            if field != "value" or square:
+                return None  # value law must stay affine in e % vmod
+            vals = vals * scale + offset
+        else:
+            if m[0] == "mod_eq":
+                if m[1] != "value":
+                    return None
+                keep = (vals % m[2]) == m[3]
+            else:
+                op, field, c = m
+                if field != "value":
+                    return None
+                keep = {"lt": vals < c, "le": vals <= c, "gt": vals > c,
+                        "ge": vals >= c, "eq": vals == c}[op]
+            mask = keep if mask is None else (mask & keep)
+    if mask is not None:
+        if getattr(w, "_renumbering", False):
+            return None  # renumbering compacts ids AFTER the filter
+        g = math.gcd(src.n_keys, vmod)
+        win_len, _, _ = _window_geometry(w)
+        if win_len < vmod // g:
+            return None  # a window might not cover a residue cycle
+        for c in range(g):
+            if not mask[c::g].any():
+                return None  # keys of this class would have no tuples
+        mask = mask.astype(np.uint8)
+    return mask, vals
+
+
+def _run_columnar_synth(graph, plan, mask, vtab) -> bool:
+    """Execute the folded chain: fused C++ generate+filter+fold, numpy
+    window finalization over the staged pane partials, record-plane
+    emission contract at the sink."""
+    import numpy as np
+
+    from ..core.context import RuntimeContext
+    from ..core.meta import with_context
+    from ..core.tuples import BasicRecord
+    from ..runtime.native import NativeWindowEngine
+
+    w = plan["window"]
+    src = plan["source"][1]
+    win_len, slide_len, is_tb = _window_geometry(w)
+    kind = w.win_kind_name
+    # ids are dense from 0, so the renumber lane would assign the same
+    # ids (no filters reach here with renumbering -- see the spec fn)
+    eng = NativeWindowEngine(win_len, slide_len, is_tb, 0,
+                             renumber=False, kind=kind)
+    sink_ctx = RuntimeContext(1, 0)
+    sink_fn = with_context(plan["sink"].fn, 1, sink_ctx)
+
+    def drain():
+        while True:
+            out = eng.flush(1 << 20)
+            if out is None:
+                return
+            vals, starts, ends, d_keys, d_gwids, d_rts = out[:6]
+            cs = np.concatenate([[0.0], np.cumsum(vals)])
+            wins = cs[ends] - cs[starts]
+            if kind == "mean":
+                cc = np.concatenate([[0.0], np.cumsum(out[6])])
+                wins = wins / np.maximum(cc[ends] - cc[starts], 1.0)
+            for j in range(len(d_keys)):
+                sink_fn(BasicRecord(int(d_keys[j]), int(d_gwids[j]),
+                                    int(d_rts[j]), float(wins[j])))
+
+    graph._started = True
+    step = 1 << 20
+    i = 0
+    while i < src.n_events:
+        c = min(step, src.n_events - i)
+        eng.synth_ingest(i, c, src.n_keys, src.vmod, 1.0, 0.0, mask,
+                         vtab)
+        drain()
+        i += c
+    eng.eos()
+    drain()
+    graph._ended = True
+    graph._lowered = True
+    graph._lowered_columnar = True
+    sink_fn(None)
+    return True
+
+
 def try_run_native(graph) -> bool:
     """Run the graph on the native record plane if it lowers.
     Returns True when the run completed natively."""
     plan = _lower_plan(graph)
     if plan is None:
         return False
+    if plan["source"][0] == "synth":
+        spec = _columnar_synth_spec(plan)
+        if spec is not None:
+            return _run_columnar_synth(graph, plan, *spec)
     from ..core.context import RuntimeContext
     from ..core.meta import with_context
     from ..core.tuples import BasicRecord
     from ..runtime.native import NativeRecordPipeline
 
     w = plan["window"]
-    win_type = w.win_type
-    if isinstance(w.win_type, WinType):
-        is_tb = w.win_type == WinType.TB
-    else:
-        is_tb = bool(win_type)
-    win_len = w.kwargs["win_len"] if hasattr(w, "kwargs") else w.win_len
-    slide_len = w.kwargs["slide_len"] if hasattr(w, "kwargs") else w.slide_len
+    win_len, slide_len, is_tb = _window_geometry(w)
     renumber = getattr(w, "_renumbering", False)
 
     rp = NativeRecordPipeline("fused", plan["shards"], store_results=True)

@@ -30,8 +30,13 @@ _SRCS = [os.path.join(_NATIVE_DIR, f)
 _SO = os.path.join(_NATIVE_DIR, "libwindflow_native.so")
 
 
-_CMD = ["g++", "-O3", "-march=native", "-std=c++17", "-shared",
-        "-fPIC", "-pthread", *_SRCS, "-o", _SO]
+# -ffp-contract=off: the declared Python/numpy plane rounds mul and
+# add separately; FMA contraction in the lowered planes would differ
+# by 1 ULP at exact filter thresholds (lowering must never change
+# results)
+_CMD = ["g++", "-O3", "-march=native", "-ffp-contract=off",
+        "-std=c++17", "-shared", "-fPIC", "-pthread", *_SRCS,
+        "-o", _SO]
 _STAMP = _SO + ".cmd"
 
 
@@ -115,6 +120,11 @@ def get_lib():
         lib.wfn_engine_synth_ingest.argtypes = [
             ctypes.c_void_p, LL, LL, LL, LL,
             ctypes.c_double, ctypes.c_double]
+        lib.wfn_engine_synth_ingest_masked.restype = LL
+        lib.wfn_engine_synth_ingest_masked.argtypes = [
+            ctypes.c_void_p, LL, LL, LL, LL,
+            ctypes.c_double, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_ubyte), PD]
         lib.wfn_engine_ready.restype = LL
         lib.wfn_engine_ready.argtypes = [ctypes.c_void_p]
         lib.wfn_engine_ignored.restype = LL
@@ -443,12 +453,33 @@ class NativeWindowEngine:
 
     def synth_ingest(self, start: int, n: int, n_keys: int,
                      vmod: int = 97, vscale: float = 1.0,
-                     voff: float = 0.0) -> int:
+                     voff: float = 0.0, mask=None, vtab=None) -> int:
         """Fused generate+fold of the declared synthetic law
         (operators/synth.py): events [start, start+n) never materialize
-        as host arrays.  Returns the ready-window count."""
-        return self.lib.wfn_engine_synth_ingest(
-            self.ptr, start, n, n_keys, vmod, vscale, voff)
+        as host arrays.  ``mask`` (uint8[vmod], optional) drops events
+        whose mask[e % vmod] entry is 0 -- the folded form of a
+        declared value-predicate Filter; a dropped event neither folds
+        nor advances triggering.  ``vtab`` (float64[vmod], optional)
+        overrides the affine law with a per-residue value table (the
+        sequentially-applied declared map chain).  Returns the
+        ready-window count."""
+        if mask is None and vtab is None:
+            return self.lib.wfn_engine_synth_ingest(
+                self.ptr, start, n, n_keys, vmod, vscale, voff)
+        import numpy as np
+        PD = ctypes.POINTER(ctypes.c_double)
+        mp = None
+        if mask is not None:
+            mask = np.ascontiguousarray(mask, np.uint8)
+            assert len(mask) == vmod
+            mp = mask.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte))
+        vp = None
+        if vtab is not None:
+            vtab = np.ascontiguousarray(vtab, np.float64)
+            assert len(vtab) == vmod
+            vp = vtab.ctypes.data_as(PD)
+        return self.lib.wfn_engine_synth_ingest_masked(
+            self.ptr, start, n, n_keys, vmod, vscale, voff, mp, vp)
 
     def ready(self) -> int:
         return self.lib.wfn_engine_ready(self.ptr)
