@@ -239,6 +239,28 @@ def test_columnar_synth_lowering_falls_back(case, middles, kind, win):
             (case, k)
 
 
+@pytest.mark.parametrize("win,slide", [
+    (256, 256),   # tumbling
+    (128, 384),   # hopping: inter-window gaps
+    (97, 40),     # win == vmod exactly at the coverage gate
+])
+def test_columnar_synth_lowering_geometries(win, slide):
+    """Masked folding across window geometries: tumbling, hopping
+    (gap ids belong to no window on either plane), and a window width
+    exactly at the residue-cycle coverage gate."""
+    def middles():
+        return [Map(F.value * 2.0), Filter(F.value < 120.0)]
+
+    col, low1, is_col = _run_declared(middles, win=win, slide=slide)
+    rec, low2, _ = _run_declared(middles, win=win, slide=slide,
+                                 columnar_off=True)
+    assert low1 and low2 and is_col
+    assert col.keys() == rec.keys() and len(col) > 20
+    for k in col:
+        assert abs(col[k] - rec[k]) <= 1e-9 * max(1, abs(rec[k])), \
+            (k, col[k], rec[k])
+
+
 def test_columnar_synth_lowering_all_masked_eos_tail():
     """The stream's last partial window contains only filtered-out
     residues: the record plane never opens it (EOS fires up to the
