@@ -355,14 +355,18 @@ def run_nexmark(query, n_bids):
 
     sink = _CountSink()
     g = wf.PipeGraph(f"bench6_{query}", wf.Mode.DEFAULT)
+    nex_batch = 4 * DEVICE_BATCH  # fewer, larger launches: the bid
+    #                                 stream fires many small windows
     if query == "q5":
         build_q5_hot_items(g, n_bids, 1 << 18, 1 << 17, sink,
                            batch_size=SOURCE_BATCH,
-                           device_batch=DEVICE_BATCH)
+                           device_batch=nex_batch,
+                           inflight_depth=INFLIGHT)
     else:
         build_q7_highest_bid(g, n_bids, 1 << 18, sink,
                              batch_size=SOURCE_BATCH,
-                             device_batch=DEVICE_BATCH)
+                             device_batch=nex_batch,
+                             inflight_depth=INFLIGHT)
     t0 = time.perf_counter()
     g.run()
     dt = time.perf_counter() - t0

@@ -188,6 +188,22 @@ def _run_declared(middles, kind="sum", n=80_000, K=8, win=256, slide=128,
             getattr(g, "_lowered_columnar", False))
 
 
+def _assert_planes_match(middles, kind="sum", win=256, slide=128,
+                         tol=1e-9, min_windows=20, **kw):
+    """Run the chain on both lowered planes; identical window sets,
+    values equal within accumulation-order rounding."""
+    col, low1, is_col = _run_declared(middles, kind=kind, win=win,
+                                      slide=slide, **kw)
+    rec, low2, _ = _run_declared(middles, kind=kind, win=win,
+                                 slide=slide, columnar_off=True, **kw)
+    assert low1 and low2 and is_col, (low1, low2, is_col)
+    assert col.keys() == rec.keys() and len(col) > min_windows
+    for k in col:
+        assert abs(col[k] - rec[k]) <= tol * max(1, abs(rec[k])), \
+            (k, col[k], rec[k])
+    return col
+
+
 @pytest.mark.parametrize("kind", ["sum", "count", "mean"])
 @pytest.mark.parametrize("middles_name,middles", [
     ("plain", lambda: []),
@@ -206,13 +222,7 @@ def test_columnar_synth_lowering_matches_record_plane(kind, middles_name,
     the record plane's windows -- across kinds, dropping filters, and
     filters sandwiched between maps.  win=256 > vmod=97 keeps the
     every-window-covers-a-residue-cycle gate satisfied."""
-    col, low1, is_col = _run_declared(middles, kind=kind)
-    rec, low2, _ = _run_declared(middles, kind=kind, columnar_off=True)
-    assert low1 and low2 and is_col, (low1, low2, is_col)
-    assert col.keys() == rec.keys() and len(col) > 50
-    for k in col:
-        assert abs(col[k] - rec[k]) <= 1e-9 * max(1, abs(rec[k])), \
-            (k, col[k], rec[k])
+    _assert_planes_match(middles, kind=kind, min_windows=50)
 
 
 @pytest.mark.parametrize("case,middles,kind,win", [
@@ -251,14 +261,7 @@ def test_columnar_synth_lowering_geometries(win, slide):
     def middles():
         return [Map(F.value * 2.0), Filter(F.value < 120.0)]
 
-    col, low1, is_col = _run_declared(middles, win=win, slide=slide)
-    rec, low2, _ = _run_declared(middles, win=win, slide=slide,
-                                 columnar_off=True)
-    assert low1 and low2 and is_col
-    assert col.keys() == rec.keys() and len(col) > 20
-    for k in col:
-        assert abs(col[k] - rec[k]) <= 1e-9 * max(1, abs(rec[k])), \
-            (k, col[k], rec[k])
+    _assert_planes_match(middles, win=win, slide=slide)
 
 
 def test_columnar_synth_lowering_all_masked_eos_tail():
@@ -271,16 +274,9 @@ def test_columnar_synth_lowering_all_masked_eos_tail():
 
     # K=1: ids == events; n=12426 ends with ids 12416..12425 (residues
     # 0..9 mod 97, all < 50 -> all masked) inside tail window 97
-    col, low1, is_col = _run_declared(middles, n=12_426, K=1,
-                                      win=128, slide=128)
-    rec, low2, _ = _run_declared(middles, n=12_426, K=1, win=128,
-                                 slide=128, columnar_off=True)
-    assert low1 and is_col and low2
-    assert col.keys() == rec.keys(), (
-        sorted(set(col) ^ set(rec)))
+    col = _assert_planes_match(middles, n=12_426, K=1, win=128,
+                               slide=128, tol=0.0, min_windows=10)
     assert (0, 97) not in col  # the all-masked tail never opens
-    for k in col:
-        assert col[k] == rec[k], (k, col[k], rec[k])
 
 
 def test_columnar_synth_lowering_sequential_float_semantics():
@@ -299,15 +295,9 @@ def test_columnar_synth_lowering_sequential_float_semantics():
         return [Map(F.value * 0.1), Map(F.value * 0.7),
                 Filter(F.value >= float(v30))]
 
-    col, _, is_col = _run_declared(middles)
-    rec, _, _ = _run_declared(middles, columnar_off=True)
-    assert is_col
-    assert col.keys() == rec.keys()
-    for k in col:
-        # 1e-12 rel: accumulation-order rounding only; a dropped/kept
-        # tuple difference would be ~1e-2 relative at these values
-        assert abs(col[k] - rec[k]) <= 1e-12 * max(1, abs(rec[k])), \
-            (k, col[k], rec[k])
+    # 1e-12 rel: accumulation-order rounding only; a dropped/kept
+    # tuple difference would be ~1e-2 relative at these values
+    _assert_planes_match(middles, tol=1e-12)
 
 
 def test_columnar_synth_lowering_all_masked_class_falls_back():
