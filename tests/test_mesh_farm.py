@@ -344,7 +344,7 @@ def test_pane_farm_mesh_kinds(kind):
 
 
 @pytest.mark.parametrize("win_axis", [2, 4, 8])
-@pytest.mark.parametrize("win,slide", [(12, 4), (8, 8)])
+@pytest.mark.parametrize("win,slide", [(12, 4), (8, 8), (4, 12)])
 def test_wmr_mesh_matches_oracle(win_axis, win, slide):
     """WinMapReduceMesh (round-robin stripes + psum over 'win') vs the
     sequential oracle -- the third mesh distribution as a graph
