@@ -419,8 +419,9 @@ def main():
               "backend", file=sys.stderr)
         backend = "cpu-fallback"
         note = ("TPU transport unreachable at bench time; last measured "
-                "TPU headline 58.4M tuples/s = 1.99x baseline, p99 143ms "
-                "(BASELINE.md r4 measured table)")
+                "TPU headline 177.4M tuples/s = 5.61x baseline "
+                "(bench_runs/r5_inround.json, full-run capture; "
+                "BASELINE.md carries the generated table)")
         import jax
         jax.config.update("jax_platforms", "cpu")
     rtt_ms = _transport_rtt_ms()
