@@ -154,7 +154,8 @@ def _run_chain(lower, n=20_000, K=8, win=32, slide=16,
 
 
 def _run_declared(middles, kind="sum", n=80_000, K=8, win=256, slide=128,
-                  win_type=WinType.TB, lower=True, columnar_off=False):
+                  win_type=WinType.TB, lower=True, columnar_off=False,
+                  vmod=97):
     """Run a declared SyntheticSource chain; returns (windows dict,
     lowered?, columnar?)."""
     got = {}
@@ -168,8 +169,8 @@ def _run_declared(middles, kind="sum", n=80_000, K=8, win=256, slide=128,
 
     cfg = RuntimeConfig(native_record_lowering=lower)
     g = wf.PipeGraph("decl", wf.Mode.DEFAULT, cfg)
-    pipe = g.add_source(SyntheticSource(n, K, emit_batches=False,
-                                        batch=4096))
+    pipe = g.add_source(SyntheticSource(n, K, vmod=vmod,
+                                        emit_batches=False, batch=4096))
     for op in middles():
         pipe = pipe.add(op)
     pipe.add(KeyFarm(kind, win, slide, win_type, parallelism=3)) \
@@ -189,19 +190,24 @@ def _run_declared(middles, kind="sum", n=80_000, K=8, win=256, slide=128,
 
 
 def _assert_planes_match(middles, kind="sum", win=256, slide=128,
-                         tol=1e-9, min_windows=20, **kw):
+                         tol=1e-9, min_windows=20, require_columnar=True,
+                         **kw):
     """Run the chain on both lowered planes; identical window sets,
-    values equal within accumulation-order rounding."""
+    values equal within accumulation-order rounding.  Returns
+    (windows, took_columnar)."""
     col, low1, is_col = _run_declared(middles, kind=kind, win=win,
                                       slide=slide, **kw)
     rec, low2, _ = _run_declared(middles, kind=kind, win=win,
                                  slide=slide, columnar_off=True, **kw)
-    assert low1 and low2 and is_col, (low1, low2, is_col)
-    assert col.keys() == rec.keys() and len(col) > min_windows
+    assert low1 and low2, (low1, low2)
+    if require_columnar:
+        assert is_col
+    assert col.keys() == rec.keys() and len(col) >= min_windows, \
+        (len(col), min_windows)
     for k in col:
         assert abs(col[k] - rec[k]) <= tol * max(1, abs(rec[k])), \
             (k, col[k], rec[k])
-    return col
+    return col, is_col
 
 
 @pytest.mark.parametrize("kind", ["sum", "count", "mean"])
@@ -274,8 +280,8 @@ def test_columnar_synth_lowering_all_masked_eos_tail():
 
     # K=1: ids == events; n=12426 ends with ids 12416..12425 (residues
     # 0..9 mod 97, all < 50 -> all masked) inside tail window 97
-    col = _assert_planes_match(middles, n=12_426, K=1, win=128,
-                               slide=128, tol=0.0, min_windows=10)
+    col, _ = _assert_planes_match(middles, n=12_426, K=1, win=128,
+                                  slide=128, tol=0.0, min_windows=10)
     assert (0, 97) not in col  # the all-masked tail never opens
 
 
@@ -298,6 +304,59 @@ def test_columnar_synth_lowering_sequential_float_semantics():
     # 1e-12 rel: accumulation-order rounding only; a dropped/kept
     # tuple difference would be ~1e-2 relative at these values
     _assert_planes_match(middles, tol=1e-12)
+
+
+_SWEEP_OUTCOMES = set()
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_columnar_synth_lowering_randomized_property(seed):
+    """Seeded property sweep: random geometry, vmod, key count, and a
+    random chain of affine maps / value filters.  Whatever the plan
+    decides (fold or fall back), the results must equal the record
+    plane; across the sweep both outcomes must actually occur."""
+    import random
+    rnd = random.Random(1000 + seed)
+    K = rnd.choice([1, 2, 5, 8])
+    vmod = rnd.choice([7, 32, 97])
+    win = rnd.choice([24, 97, 160, 256])
+    slide = rnd.choice([max(8, win // 3), win // 2 or 1, win,
+                        win + win // 2])
+    kind = rnd.choice(["sum", "count", "mean"])
+
+    # draw the chain as a SPEC so each plane builds fresh operator
+    # instances (operators are single-graph objects)
+    spec = []
+    for _ in range(rnd.randint(0, 3)):
+        if rnd.random() < 0.5:
+            spec.append(("map", rnd.choice([2.0, 0.5, -1.5]),
+                         rnd.choice([0.0, 1.0, -7.0])))
+        elif rnd.random() < 0.7:
+            spec.append(("ge", rnd.uniform(-20.0, 60.0)))
+        else:
+            spec.append(("mod", rnd.choice([2, 3, 5])))
+
+    def middles():
+        ops = []
+        for entry in spec:
+            if entry[0] == "map":
+                ops.append(Map(F.value * entry[1] + entry[2]))
+            elif entry[0] == "ge":
+                ops.append(Filter(F.value >= entry[1]))
+            else:
+                ops.append(Filter(F.value % entry[1] == 0))
+        return ops
+
+    col, took_col = _assert_planes_match(
+        middles, kind=kind, n=30_000, K=K, win=win, slide=slide,
+        vmod=vmod, min_windows=0, require_columnar=False)
+    _SWEEP_OUTCOMES.add(took_col)
+    _SWEEP_OUTCOMES.add(("nonempty", True) if col else ("empty", True))
+    if seed == 11:  # after the full sweep: both paths really ran, and
+        #             the sweep wasn't vacuously comparing empty sets
+        assert True in _SWEEP_OUTCOMES and False in _SWEEP_OUTCOMES, \
+            _SWEEP_OUTCOMES
+        assert ("nonempty", True) in _SWEEP_OUTCOMES, _SWEEP_OUTCOMES
 
 
 def test_columnar_synth_lowering_all_masked_class_falls_back():
