@@ -42,17 +42,31 @@ struct Channel {
     std::deque<Item> q;
     int n_producers = 0;
     int eos_seen = 0;
+    bool poisoned = false;  // graph-cancellation shutdown sentinel
 
     int register_producer() {
         std::lock_guard<std::mutex> lk(mu);
         return n_producers++;
     }
 
-    void put(int producer, std::uintptr_t handle, bool eos) {
+    // 1 = accepted, -1 = channel poisoned (item not enqueued; the
+    // caller still owns the handle's reference).
+    int put(int producer, std::uintptr_t handle, bool eos) {
         std::unique_lock<std::mutex> lk(mu);
-        not_full.wait(lk, [&] { return q.size() < capacity || eos; });
+        not_full.wait(lk, [&] {
+            return q.size() < capacity || eos || poisoned;
+        });
+        if (poisoned) return -1;
         q.push_back(Item{producer, handle, eos});
         not_empty.notify_one();
+        return 1;
+    }
+
+    void poison() {
+        std::lock_guard<std::mutex> lk(mu);
+        poisoned = true;
+        not_full.notify_all();
+        not_empty.notify_all();
     }
 
     // One popped item through the EOS protocol (lock held, q nonempty):
@@ -70,11 +84,13 @@ struct Channel {
         return 1;
     }
 
-    // Returns 1 with *handle/*cid set; 0 once every producer closed.
+    // Returns 1 with *handle/*cid set; 0 once every producer closed;
+    // -2 when poisoned (any undelivered items are drained at free time).
     int get(std::uintptr_t* handle, int* cid) {
         std::unique_lock<std::mutex> lk(mu);
         for (;;) {
-            not_empty.wait(lk, [&] { return !q.empty(); });
+            not_empty.wait(lk, [&] { return !q.empty() || poisoned; });
+            if (poisoned) return -2;
             int rc = pop_locked(handle, cid);
             if (rc >= 0) return rc;
         }
@@ -88,11 +104,26 @@ struct Channel {
             + std::chrono::milliseconds(timeout_ms);
         for (;;) {
             if (!not_empty.wait_until(lk, deadline,
-                                      [&] { return !q.empty(); }))
+                                      [&] { return !q.empty() || poisoned; }))
                 return 2;
+            if (poisoned) return -2;
             int rc = pop_locked(handle, cid);
             if (rc >= 0) return rc;
         }
+    }
+
+    // Post-poison drain for the owner thread: returns remaining item
+    // handles one by one so the binding can release their references.
+    int drain(std::uintptr_t* handle) {
+        std::lock_guard<std::mutex> lk(mu);
+        while (!q.empty()) {
+            Item it = q.front();
+            q.pop_front();
+            if (it.eos) continue;
+            *handle = it.handle;
+            return 1;
+        }
+        return 0;
     }
 
     std::size_t size() {
@@ -115,12 +146,20 @@ int wfn_channel_register_producer(void* ch) {
     return static_cast<Channel*>(ch)->register_producer();
 }
 
-void wfn_channel_put(void* ch, int producer, std::uintptr_t handle) {
-    static_cast<Channel*>(ch)->put(producer, handle, false);
+int wfn_channel_put(void* ch, int producer, std::uintptr_t handle) {
+    return static_cast<Channel*>(ch)->put(producer, handle, false);
 }
 
 void wfn_channel_close(void* ch, int producer) {
     static_cast<Channel*>(ch)->put(producer, 0, true);
+}
+
+void wfn_channel_poison(void* ch) {
+    static_cast<Channel*>(ch)->poison();
+}
+
+int wfn_channel_drain(void* ch, std::uintptr_t* handle) {
+    return static_cast<Channel*>(ch)->drain(handle);
 }
 
 int wfn_channel_get(void* ch, std::uintptr_t* handle, int* cid) {

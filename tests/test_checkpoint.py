@@ -128,6 +128,10 @@ def test_win_seq_tpu_checkpoint_midstream(force_python):
     import numpy as np
     from windflow_tpu.core.tuples import TupleBatch
     from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPULogic
+    from windflow_tpu.runtime.native import native_available
+    if not force_python and not native_available():
+        pytest.skip("native engine path needs the native runtime "
+                    "(WINDFLOW_NATIVE=0 or no toolchain)")
 
     def make_logic():
         lg = WinSeqTPULogic("sum", 32, 16, WinType.TB, batch_len=64,

@@ -1,0 +1,551 @@
+"""Failure-containment tests (resilience/): graph cancellation on
+replica death, per-operator error policies + dead-letter quarantine,
+the stall watchdog, and the deterministic fault-injection harness.
+
+Every failure scenario here is driven by resilience.faults.FaultPlan
+(or an explicit user-function failure), never by timing races: the
+recovery paths must fire deterministically.
+"""
+import json
+import threading
+import time
+import warnings
+
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core import BasicRecord, RuntimeConfig
+from windflow_tpu.graph.pipegraph import NodeFailureError, StallError
+from windflow_tpu.resilience import (CancelToken, DeadLetterStore,
+                                     FaultPlan, GraphCancelled,
+                                     InjectedFailure)
+from windflow_tpu.runtime.queues import Channel
+
+WAIT_S = 60  # generous outer bound; the paths under test finish in ms
+
+
+def counting_source(n, state=None):
+    state = state if state is not None else {}
+
+    def fn(shipper, ctx):
+        i = state.setdefault("i", 0)
+        if i >= n:
+            return False
+        shipper.push(BasicRecord(i % 2, i // 2, i, float(i)))
+        state["i"] = i + 1
+        return True
+
+    return fn
+
+
+class CollectingSink:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.values = []
+
+    def __call__(self, rec):
+        if rec is not None:
+            with self.lock:
+                self.values.append(rec.value)
+
+
+def run_in_thread(fn, timeout=WAIT_S):
+    """Run fn on a worker thread; fail the test (instead of hanging
+    the suite) if it does not finish in time.  Returns the exception
+    fn raised, or None."""
+    box = {}
+
+    def target():
+        try:
+            fn()
+        except BaseException as e:
+            box["error"] = e
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(timeout)
+    assert not t.is_alive(), "graph run did not complete: deadlock?"
+    return box.get("error")
+
+
+# ---------------------------------------------------------------------------
+# channel poisoning / CancelToken
+# ---------------------------------------------------------------------------
+
+def test_channel_poison_unblocks_blocked_put():
+    ch = Channel(capacity=2)
+    pid = ch.register_producer()
+    ch.put(pid, "a")
+    ch.put(pid, "b")  # full now
+    raised = threading.Event()
+
+    def blocked_put():
+        try:
+            ch.put(pid, "c")  # blocks on the bounded buffer
+        except GraphCancelled:
+            raised.set()
+
+    t = threading.Thread(target=blocked_put, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert t.is_alive()  # genuinely blocked
+    ch.poison()
+    assert raised.wait(5), "poison did not unblock the producer"
+
+
+def test_channel_poison_unblocks_blocked_get():
+    ch = Channel(capacity=2)
+    ch.register_producer()
+    raised = threading.Event()
+
+    def blocked_get():
+        try:
+            ch.get()
+        except GraphCancelled:
+            raised.set()
+
+    t = threading.Thread(target=blocked_get, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    ch.poison()
+    assert raised.wait(5), "poison did not unblock the consumer"
+    # post-poison operations fail immediately
+    with pytest.raises(GraphCancelled):
+        ch.get(timeout=0.5)
+    ch.close(0)  # close after poison is a silent no-op
+
+
+def test_native_channel_poison_unblocks_blocked_put():
+    from windflow_tpu.runtime.native import NativeChannel, native_available
+    if not native_available():
+        pytest.skip("native runtime unavailable")
+    ch = NativeChannel(2)
+    pid = ch.register_producer()
+    ch.put(pid, "a")
+    ch.put(pid, "b")
+    raised = threading.Event()
+
+    def blocked_put():
+        try:
+            ch.put(pid, "c")
+        except GraphCancelled:
+            raised.set()
+
+    t = threading.Thread(target=blocked_put, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert t.is_alive()
+    ch.poison()
+    assert raised.wait(5), "poison did not unblock the native producer"
+    with pytest.raises(GraphCancelled):
+        ch.get(timeout=0.2)
+
+
+def test_cancel_token_idempotent_and_late_registration():
+    tok = CancelToken()
+    ch1, ch2 = Channel(4), Channel(4)
+    tok.register(ch1)
+    err = RuntimeError("boom")
+    assert tok.cancel(err, origin="n1")
+    assert not tok.cancel(RuntimeError("later"), origin="n2")
+    assert tok.reason is err and tok.origin == "n1"
+    assert ch1.poisoned
+    tok.register(ch2)  # registered after the cancel: poisoned at once
+    assert ch2.poisoned
+
+
+# ---------------------------------------------------------------------------
+# the deadlock regression (satellite): replica dies with a full channel
+# ---------------------------------------------------------------------------
+
+def test_replica_crash_with_full_channel_does_not_deadlock():
+    """The seed behaviour this PR removes: a middle replica dies, its
+    bounded input channel fills, the source blocks in put() forever and
+    wait_end never returns.  With graph cancellation the run must end
+    and raise NodeFailureError naming the dead replica."""
+    plan = FaultPlan(seed=1).crash_replica("map", at_tuple=5)
+    cfg = RuntimeConfig(queue_capacity=4, fault_plan=plan)
+    sink = CollectingSink()
+    g = wf.PipeGraph("deadlock", config=cfg)
+    g.add_source(wf.SourceBuilder(counting_source(50_000)).build()) \
+        .add(wf.MapBuilder(lambda t: None).with_name("map").build()) \
+        .add_sink(wf.SinkBuilder(sink).build())
+
+    err = run_in_thread(g.run)
+    assert isinstance(err, NodeFailureError), err
+    assert err.errors, "NodeFailureError.errors must list the failures"
+    names = [n for n, _ in err.errors]
+    assert any("map" in n for n in names), names
+    assert all(isinstance(e, InjectedFailure) for _, e in err.errors)
+
+
+def test_wait_end_collects_every_failed_replica():
+    """Both replicas of a 2-parallel map fail (a barrier guarantees
+    each has taken a tuple before either raises): wait_end must report
+    BOTH, not just errors[0]."""
+    barrier = threading.Barrier(2)
+
+    def failing(t):
+        barrier.wait(timeout=30)
+        raise ValueError(f"replica poisoned tuple {t.id}")
+
+    g = wf.PipeGraph("all-errors")
+    g.add_source(wf.SourceBuilder(counting_source(100)).build()) \
+        .add(wf.MapBuilder(failing).with_parallelism(2)
+             .with_name("boom").build()) \
+        .add_sink(wf.SinkBuilder(lambda r: None).build())
+
+    err = run_in_thread(g.run)
+    assert isinstance(err, NodeFailureError)
+    failed = sorted(n for n, _ in err.errors)
+    assert len(failed) == 2 and all("boom" in n for n in failed), failed
+    # every pair is in the message too
+    for name, _ in err.errors:
+        assert name in str(err)
+
+
+def test_sibling_replicas_unwind_clean_on_cancel():
+    """When one replica dies, its siblings are cancelled, not failed:
+    they must not appear in .errors."""
+    plan = FaultPlan(seed=3).crash_replica("map.0", at_tuple=3)
+    cfg = RuntimeConfig(queue_capacity=8, fault_plan=plan)
+    g = wf.PipeGraph("sibling", config=cfg)
+    g.add_source(wf.SourceBuilder(counting_source(100_000)).build()) \
+        .add(wf.MapBuilder(lambda t: None).with_parallelism(2)
+             .with_name("map").build()) \
+        .add_sink(wf.SinkBuilder(lambda r: None).build())
+    err = run_in_thread(g.run)
+    assert isinstance(err, NodeFailureError)
+    assert [n for n, _ in err.errors] == ["pipe0/map.0"], err.errors
+
+
+def test_user_cancel_raises_node_failure():
+    stop = threading.Event()
+
+    def slow_source(shipper, ctx):
+        stop.wait(0.005)
+        shipper.push(BasicRecord(0, 0, 0, 1.0))
+        return True  # endless until cancelled
+
+    g = wf.PipeGraph("user-cancel")
+    g.add_source(wf.SourceBuilder(slow_source).build()) \
+        .add_sink(wf.SinkBuilder(lambda r: None).build())
+    g.start()
+    time.sleep(0.05)
+    assert g.cancel()
+    err = run_in_thread(g.wait_end)
+    assert isinstance(err, NodeFailureError)
+    assert "user" in str(err)
+
+
+# ---------------------------------------------------------------------------
+# error policies + dead letters
+# ---------------------------------------------------------------------------
+
+def pipeline_with_policy(policy, tmp_path=None, tracing=False):
+    def poisoned(t):
+        if int(t.value) % 7 == 3:
+            raise ValueError(f"bad tuple {t.value}")
+
+    cfg = RuntimeConfig(tracing=tracing,
+                        log_dir=str(tmp_path) if tmp_path else "log")
+    sink = CollectingSink()
+    g = wf.PipeGraph("policy", config=cfg)
+    g.add_source(wf.SourceBuilder(counting_source(70)).build()) \
+        .add(wf.MapBuilder(poisoned).with_name("fragile")
+             .with_error_policy(policy).build()) \
+        .add_sink(wf.SinkBuilder(sink).build())
+    return g, sink
+
+
+def test_skip_policy_keeps_replica_alive():
+    g, sink = pipeline_with_policy("skip")
+    g.run()  # completes despite 10 poisoned tuples
+    assert sorted(sink.values) == sorted(
+        float(v) for v in range(70) if v % 7 != 3)
+    assert g.dead_letters.count() == 0  # skip does not quarantine
+
+
+def test_dead_letter_policy_quarantines_tuples(tmp_path):
+    g, sink = pipeline_with_policy("dead_letter", tmp_path, tracing=True)
+    g.run()
+    assert sorted(sink.values) == sorted(
+        float(v) for v in range(70) if v % 7 != 3)
+    dls = g.dead_letters
+    assert dls.count() == 10
+    entries = dls.entries
+    assert len(entries) == 10
+    for e in entries:
+        assert "fragile" in e.node
+        assert isinstance(e.error, ValueError)
+        assert "bad tuple" in e.traceback  # full traceback retained
+        assert int(e.item.value) % 7 == 3  # the offending tuple itself
+    assert dls.counts_by_node() == {"pipe0/fragile.0": 10}
+
+    # counters are visible in the monitoring JSON (dumped by wait_end
+    # under tracing into log_dir)
+    import glob
+    import os
+    files = glob.glob(os.path.join(str(tmp_path), "*_policy.json"))
+    assert files, os.listdir(str(tmp_path))
+    report = json.loads(open(files[0]).read())
+    assert report["Svc_failures"] == 10
+    assert report["Dead_letter_tuples"] == 10
+    fragile = next(o for o in report["Operators"]
+                   if "fragile" in o["Operator_name"])
+    assert fragile["Replicas"][0]["Svc_failures"] == 10
+
+
+def test_fail_policy_still_cancels():
+    g, _ = pipeline_with_policy("fail")
+    err = run_in_thread(g.run)
+    assert isinstance(err, NodeFailureError)
+
+
+def test_chain_falls_back_to_add_for_policied_operator():
+    """chain() must not fuse a skip-policy operator into its upstream
+    tail (the policy would swallow the upstream half's errors too)."""
+    g = wf.PipeGraph("chain-policy")
+    pipe = g.add_source(wf.SourceBuilder(counting_source(10)).build())
+    pipe.chain(wf.MapBuilder(lambda t: None)
+               .with_error_policy("skip").with_name("m1").build())
+    assert any("m1" in n.name for n in g._all_nodes()), \
+        "skip-policy operator was fused away instead of added"
+
+
+def test_chain_does_not_inherit_tail_policy():
+    """The reverse direction: a default-'fail' operator chained after a
+    skip-policy tail must not be fused into it (it would silently
+    inherit 'skip' and its failures would vanish)."""
+    def bad_sink(rec):
+        if rec is not None:
+            raise ValueError("sink must fail loudly")
+
+    g = wf.PipeGraph("chain-inherit")
+    pipe = g.add_source(wf.SourceBuilder(counting_source(10)).build())
+    pipe.add(wf.MapBuilder(lambda t: None)
+             .with_error_policy("skip").with_name("skippy").build())
+    pipe.chain_sink(wf.SinkBuilder(bad_sink).build())
+    err = run_in_thread(g.run)
+    assert isinstance(err, NodeFailureError), \
+        "sink failure was swallowed by the upstream skip policy"
+    assert any("sink" in n for n, _ in err.errors), err.errors
+
+
+def test_fault_rules_do_not_bind_to_collectors():
+    plan = FaultPlan().crash_replica("winseq", at_tuple=5)
+    assert plan.for_node("pipe0/winseq.0") is not None
+    assert plan.for_node("pipe0/winseq.coll0") is None
+    assert plan.for_node("pipe0/winseq.collector") is None
+    assert plan.for_node("pipe0/winseq.coll.g1") is None
+
+
+def test_channel_capacity_zero_is_unbounded():
+    """queue_capacity=0 meant 'unbounded' in the queue.Queue-backed
+    channel; the rewrite must preserve that."""
+    ch = Channel(capacity=0)
+    pid = ch.register_producer()
+    for i in range(10_000):  # would deadlock on a bounded channel
+        ch.put(pid, i)
+    assert ch.qsize() == 10_000
+
+
+def test_native_lowering_forfeited_under_resilience_config():
+    """A lowerable declared pipeline must fall back to the RtNode plane
+    when a FaultPlan or watchdog is configured (the lowered run has no
+    replicas/channels for them to act on)."""
+    from windflow_tpu.graph.native_lowering import _lower_plan
+    from windflow_tpu.core.expr import F
+    from windflow_tpu.core.basic import WinType
+    from windflow_tpu.operators.synth import SyntheticSource
+    from windflow_tpu.operators.basic_ops import Filter, Sink
+    from windflow_tpu.operators.win_seq import WinSeq
+
+    def build(cfg):
+        g = wf.PipeGraph("lowerable", config=cfg)
+        g.add_source(SyntheticSource(1000, 2)) \
+            .add(Filter(F.value % 2 == 0)) \
+            .add(WinSeq("sum", 8, 4, WinType.CB)) \
+            .add_sink(Sink(lambda r: None))
+        return g
+
+    base = _lower_plan(build(RuntimeConfig()))
+    if base is None:
+        pytest.skip("pipeline not lowerable here (no native runtime)")
+    assert _lower_plan(build(RuntimeConfig(
+        fault_plan=FaultPlan().crash_replica("filter", 1)))) is None
+    assert _lower_plan(build(RuntimeConfig(watchdog_timeout_s=5.0))) is None
+
+
+def test_source_builder_rejects_nonfail_policy():
+    """A source has no per-tuple svc boundary: skip/dead_letter must be
+    rejected at build time, not silently ignored at runtime."""
+    with pytest.raises(ValueError, match="fail hard"):
+        wf.SourceBuilder(lambda s, c: False).with_error_policy("skip")
+    # the default policy remains expressible
+    wf.SourceBuilder(lambda s, c: False).with_error_policy("fail").build()
+
+
+def test_dead_letter_store_bounded():
+    store = DeadLetterStore(max_entries=3)
+    for i in range(10):
+        store.add("n", i, ValueError(str(i)))
+    assert store.count() == 10          # exact count
+    assert len(store.entries) == 3      # bounded retention
+    # the traceback reflects the PASSED error even outside an except
+    # block (format_exc would have recorded "NoneType: None")
+    assert "ValueError: 0" in store.entries[0].traceback
+    store.clear()
+    assert store.count() == 0 and not store
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_cancels_stalled_graph(tmp_path):
+    """A sink that blocks forever would hang wait_end for good; the
+    watchdog must detect zero progress, dump diagnostics and cancel."""
+    block = threading.Event()  # never set
+
+    def stuck_sink(rec):
+        if rec is not None:
+            block.wait()  # simulates a wedged external system
+
+    cfg = RuntimeConfig(watchdog_timeout_s=0.5, cancel_grace_s=0.5,
+                        log_dir=str(tmp_path), queue_capacity=8)
+    g = wf.PipeGraph("stall", config=cfg)
+    g.add_source(wf.SourceBuilder(counting_source(10_000)).build()) \
+        .add_sink(wf.SinkBuilder(stuck_sink).build())
+
+    err = run_in_thread(g.run)
+    assert isinstance(err, StallError), err
+    assert isinstance(err, NodeFailureError)  # retryable by recovery
+    # the diagnostic dump exists and names the stuck channel state
+    path = g._watchdog.report_path
+    assert path is not None
+    report = json.loads(open(path).read())
+    assert any(row["node"].endswith("sink.0") for row in report["nodes"])
+    assert "thread_stacks" in report and "stuck_sink" in \
+        report["thread_stacks"]
+
+
+def test_watchdog_quiet_on_healthy_graph(tmp_path):
+    cfg = RuntimeConfig(watchdog_timeout_s=5.0, log_dir=str(tmp_path))
+    sink = CollectingSink()
+    g = wf.PipeGraph("healthy", config=cfg)
+    g.add_source(wf.SourceBuilder(counting_source(200)).build()) \
+        .add_sink(wf.SinkBuilder(sink).build())
+    g.run()
+    assert not g._watchdog.fired
+    assert len(sink.values) == 200
+
+
+# ---------------------------------------------------------------------------
+# fault-injection harness
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_crash_is_deterministic():
+    """The same plan against the same pipeline crashes at the same
+    tuple every run (no sleeps, no races)."""
+    for _ in range(3):
+        taken = []
+        plan = FaultPlan(seed=9).crash_replica("victim", at_tuple=7)
+        cfg = RuntimeConfig(fault_plan=plan)
+
+        def observer(t):
+            taken.append(int(t.value))
+
+        g = wf.PipeGraph("det", config=cfg)
+        g.add_source(wf.SourceBuilder(counting_source(1000)).build()) \
+            .add(wf.MapBuilder(observer).with_name("victim").build()) \
+            .add_sink(wf.SinkBuilder(lambda r: None).build())
+        err = run_in_thread(g.run)
+        assert isinstance(err, NodeFailureError)
+        assert len(taken) == 6  # tuples 1..6 processed, 7th injected
+
+
+def test_fault_plan_put_delays_apply():
+    plan = FaultPlan(seed=2).delay_puts("source", delay_s=0.004)
+    cfg = RuntimeConfig(fault_plan=plan)
+    g = wf.PipeGraph("slow", config=cfg)
+    g.add_source(wf.SourceBuilder(counting_source(50)).build()) \
+        .add_sink(wf.SinkBuilder(lambda r: None).build())
+    t0 = time.monotonic()
+    g.run()
+    assert time.monotonic() - t0 >= 50 * 0.004  # sleeps really ran
+
+
+def test_forced_native_build_failure_and_channel_warning():
+    """fail_native_build() forces the toolchain probe down; make_channel
+    must fall back to the Python channel and warn exactly once."""
+    import os
+    if os.environ.get("WINDFLOW_NATIVE", "1") == "0":
+        pytest.skip("warning is deliberately suppressed when the native "
+                    "plane is disabled via WINDFLOW_NATIVE=0")
+    from windflow_tpu.runtime import queues
+    from windflow_tpu.runtime.native import native_available
+
+    with FaultPlan().fail_native_build():
+        assert not native_available()
+        queues._native_warned = False  # fresh warn-once state
+        cfg = RuntimeConfig(use_native_runtime=True)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            ch1 = queues.make_channel(cfg)
+            ch2 = queues.make_channel(cfg)
+        assert type(ch1).__name__ == "Channel"
+        assert type(ch2).__name__ == "Channel"
+        runtime_warns = [w for w in caught
+                         if issubclass(w.category, RuntimeWarning)]
+        assert len(runtime_warns) == 1  # once, not per channel
+        assert "native runtime unavailable" in str(runtime_warns[0].message)
+    queues._native_warned = False
+
+
+def test_run_with_recovery_after_injected_midstream_crash(tmp_path):
+    """The headline acceptance path: a FaultPlan kills a mid-pipeline
+    replica on attempt 0 (full-channel conditions), the contained
+    failure surfaces as NodeFailureError, and run_with_recovery
+    restores the accumulator checkpoint and completes on attempt 1."""
+    from windflow_tpu.utils.checkpoint import run_with_recovery
+
+    ckpt = str(tmp_path / "rec.pkl")
+    observed = {"attempts": 0, "failures": []}
+
+    def acc_fn(t, acc):
+        acc.value += t.value
+
+    def factory(attempt):
+        observed["attempts"] += 1
+        plan = (FaultPlan(seed=4).crash_replica("accumulator", at_tuple=20)
+                if attempt == 0 else None)
+        cfg = RuntimeConfig(queue_capacity=4, fault_plan=plan)
+        g = wf.PipeGraph("recover", config=cfg)
+        g.add_source(wf.SourceBuilder(counting_source(5000)).build()) \
+            .add(wf.AccumulatorBuilder(acc_fn)
+                 .with_initial_value(BasicRecord(value=0.0)).build()) \
+            .add_sink(wf.SinkBuilder(lambda r: None).build())
+        return g
+
+    def on_failure(attempt, error, graph):
+        observed["failures"].append((attempt, error))
+
+    box = {}
+
+    def run():
+        box["graph"] = run_with_recovery(factory, ckpt, max_restarts=2,
+                                         on_failure=on_failure)
+
+    err = run_in_thread(run)
+    assert err is None, err
+    assert observed["attempts"] == 2
+    (attempt0, e0), = observed["failures"]
+    assert attempt0 == 0 and isinstance(e0, NodeFailureError)
+    assert any(isinstance(x, InjectedFailure) for _, x in e0.errors)
+    # the successful attempt produced the full per-key sums
+    g = box["graph"]
+    acc_node = next(n for n in g._all_nodes() if "accumulator" in n.name)
+    finals = {k: v.value for k, v in acc_node.logic.state.items()}
+    assert finals == {0: sum(float(v) for v in range(5000) if v % 2 == 0),
+                      1: sum(float(v) for v in range(5000) if v % 2 == 1)}

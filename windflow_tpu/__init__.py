@@ -36,6 +36,13 @@ def __getattr__(name):
         "PipeGraph": "windflow_tpu.graph.pipegraph",
         "NodeFailureError": "windflow_tpu.graph.pipegraph",
         "MultiPipe": "windflow_tpu.graph.multipipe",
+        # failure containment (resilience/; docs/RESILIENCE.md)
+        "StallError": "windflow_tpu.resilience",
+        "GraphCancelled": "windflow_tpu.resilience",
+        "FaultPlan": "windflow_tpu.resilience",
+        "InjectedFailure": "windflow_tpu.resilience",
+        "DeadLetterStore": "windflow_tpu.resilience",
+        "DeadLetterEntry": "windflow_tpu.resilience",
         # mesh-scale operators + mesh construction (multi-chip plane)
         "KeyFarmMesh": "windflow_tpu.operators.tpu.mesh_farm",
         "PaneFarmMesh": "windflow_tpu.operators.tpu.pane_mesh",

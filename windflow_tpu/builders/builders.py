@@ -21,7 +21,24 @@ from ..operators.win_seq import WinSeq
 def _alias_camel(cls):
     """Attach camelCase aliases for every with_/build method, including
     ones inherited from mixins (the window-parameter surface lives on a
-    shared base, so walk the MRO, nearest definition winning)."""
+    shared base, so walk the MRO, nearest definition winning).  Also
+    wraps ``build`` so builder-level operator attributes shared by every
+    operator kind (the error policy) land on the built descriptor
+    without each build() re-implementing the copy."""
+    build = cls.__dict__.get("build")
+    if build is not None and not getattr(build, "_wf_wrapped", False):
+        import functools
+
+        @functools.wraps(build)
+        def build_wrapper(self, *a, **kw):
+            op = build(self, *a, **kw)
+            policy = getattr(self, "error_policy", "fail")
+            if policy != "fail":
+                op.error_policy = policy
+            return op
+
+        build_wrapper._wf_wrapped = True
+        cls.build = build_wrapper
     targets = {}
     for klass in cls.__mro__:
         for name, fn in vars(klass).items():
@@ -45,6 +62,7 @@ class _BuilderBase:
         self.name = self._default_name
         self.parallelism = 1
         self.closing_func = None
+        self.error_policy = "fail"
 
     def with_name(self, name: str):
         self.name = name
@@ -56,6 +74,17 @@ class _BuilderBase:
 
     def with_closing_function(self, fn: Callable):
         self.closing_func = fn
+        return self
+
+    def with_error_policy(self, policy: str):
+        """Per-tuple svc failure handling for this operator:
+        ``'fail'`` (default -- the replica dies and the graph cancels),
+        ``'skip'`` (drop the offending tuple, count it) or
+        ``'dead_letter'`` (skip + quarantine the tuple with node name
+        and traceback in ``graph.dead_letters``).  See
+        docs/RESILIENCE.md."""
+        from ..resilience.policies import validate_policy
+        self.error_policy = validate_policy(policy)
         return self
 
     def build_ptr(self):
@@ -114,6 +143,17 @@ class _WinBuilderBase(_BuilderBase):
 @_alias_camel
 class SourceBuilder(_BuilderBase):
     _default_name = "source"
+
+    def with_error_policy(self, policy: str):
+        """Sources reject non-default policies loudly: a generation
+        loop has no per-tuple svc boundary, so 'skip'/'dead_letter'
+        would validate here and then be silently ignored at runtime."""
+        from ..resilience.policies import validate_policy
+        if validate_policy(policy) != "fail":
+            raise ValueError(
+                "sources always fail hard: error policies apply to "
+                "per-tuple svc processing (docs/RESILIENCE.md)")
+        return self
 
     def build(self) -> Source:
         return Source(self.fn, self.parallelism, self.name,

@@ -9,8 +9,10 @@ config surface here (SURVEY.md §5 "Config / flag system").
 from __future__ import annotations
 
 import enum
+import os
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+from typing import Any, Optional
 
 
 class Mode(enum.Enum):
@@ -158,7 +160,22 @@ class RuntimeConfig:
     dashboard_machine: str = "localhost"
     dashboard_port: int = 20207
     log_dir: str = "log"
-    use_native_runtime: bool = True   # prefer the C++ host runtime when built
+    # prefer the C++ host runtime when built; WINDFLOW_NATIVE=0 forces
+    # the pure-Python plane (the CI matrix's second job)
+    use_native_runtime: bool = field(default_factory=lambda: os.environ.get(
+        "WINDFLOW_NATIVE", "1") != "0")
     # lower fully-declared record chains (Expr filters/maps + builtin
     # window + sink) onto the native C++ record pipeline at run()
     native_record_lowering: bool = True
+    # -- failure containment (resilience/; docs/RESILIENCE.md) ----------
+    # stall watchdog: cancel/dump when no channel makes progress for
+    # this many seconds (None/0 = disabled)
+    watchdog_timeout_s: Optional[float] = None
+    # True: the watchdog cancels the graph (wait_end raises StallError);
+    # False: it only dumps the channel/thread report and re-arms
+    watchdog_cancel: bool = True
+    # after a cancellation, how long wait_end waits for each replica
+    # thread still stuck in user code before abandoning it
+    cancel_grace_s: float = 5.0
+    # resilience.faults.FaultPlan bound to the graph at start() (tests)
+    fault_plan: Any = None

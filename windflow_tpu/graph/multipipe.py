@@ -136,6 +136,8 @@ class MultiPipe:
             else:
                 in_ch = entry_channels[i]
             node = RtNode(f"{self.name}/{stage.name}.{i}", logic, in_ch, [])
+            if stage.error_policy is not None:
+                node.error_policy = stage.error_policy
             node.group = stage.groups[i] if stage.groups is not None else None
             if self.graph.config.tracing:
                 node.stats = self.graph.stats.register(
@@ -220,6 +222,8 @@ class MultiPipe:
                 and hasattr(op, "enable_renumbering")):
             op.enable_renumbering()
         for i, stage in enumerate(op.stages()):
+            if stage.error_policy is None:
+                stage.error_policy = getattr(op, "error_policy", "fail")
             if i == 0:
                 self._swap_cb_broadcast(stage, win_type)
             self._append_stage(stage, win_type)
@@ -260,6 +264,14 @@ class MultiPipe:
         (multipipe.hpp:345-390; chain exists only for Filter/Map/
         FlatMap/Sink)."""
         self._check_open()
+        if getattr(op, "error_policy", "fail") != "fail" \
+                or any(t.error_policy != "fail" for t in self.tails):
+            # thread fusion would merge error-policy scopes: a fused
+            # node has ONE policy, so a skip/dead-letter operator would
+            # swallow its upstream half's errors -- and a 'fail'
+            # operator fused into a policied tail would inherit that
+            # tail's policy.  Keep policy scope per-operator instead
+            return self.add(op)
         logics = op.chain_logics()
         if logics is None and self.graph.mode == Mode.DEFAULT \
                 and len(self.tails) == 1:

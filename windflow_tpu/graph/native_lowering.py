@@ -41,6 +41,13 @@ def _lower_plan(graph) -> Optional[dict]:
         return None
     if graph.mode != Mode.DEFAULT or cfg.tracing or cfg.trace_runtime:
         return None
+    # resilience features live in the RtNode/channel plane: a lowered
+    # run has no replicas for a FaultPlan to bind to, no channels for
+    # the watchdog to monitor, and no per-tuple svc boundary for error
+    # policies, so their presence forfeits lowering
+    if getattr(cfg, "fault_plan", None) is not None \
+            or getattr(cfg, "watchdog_timeout_s", None):
+        return None
     if len(graph.pipes) != 1:
         return None
     mp = graph.pipes[0]
@@ -48,6 +55,8 @@ def _lower_plan(graph) -> Optional[dict]:
         return None
     ops = getattr(mp, "_ops", None)
     if not ops or len(ops) < 2:
+        return None
+    if any(getattr(op, "error_policy", "fail") != "fail" for op in ops):
         return None
     if not native_available():
         return None

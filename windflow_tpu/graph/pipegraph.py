@@ -8,20 +8,19 @@ the dropped-tuple counter :104/:763-766.
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Any, Callable, List, Optional, Sequence
 
 from ..core.basic import Mode, RuntimeConfig
 from ..operators.base import Operator
+from ..resilience.cancel import CancelToken
+# NodeFailureError's historical home is this module; it now lives in
+# resilience.errors (shared with the watchdog) and is re-exported here
+from ..resilience.errors import NodeFailureError, StallError  # noqa: F401
+from ..resilience.policies import DeadLetterStore
 from ..runtime.emitters import SplittingEmitter
 from ..runtime.node import RtNode
 from .multipipe import MultiPipe
-
-
-class NodeFailureError(RuntimeError):
-    """A replica thread died at runtime (vs. graph-validation errors,
-    which raise plain RuntimeError/ValueError and are not recoverable
-    by restarting -- utils/checkpoint.run_with_recovery retries only
-    this type)."""
 
 
 class _AppNode:
@@ -51,6 +50,11 @@ class PipeGraph:
         self._ended = False
         self._monitor = None
         self._pipe_seq = 0
+        # failure containment (resilience/): graph-wide cancellation,
+        # dead-letter quarantine, stall watchdog
+        self._cancel = CancelToken()
+        self.dead_letters = DeadLetterStore()
+        self._watchdog = None
 
     # -- construction ------------------------------------------------------
     def _new_pipe(self) -> MultiPipe:
@@ -189,23 +193,70 @@ class PipeGraph:
             self._monitor = MonitoringThread(self)
             self._monitor.start()
         # wire the live-checkpoint pause gate into every source replica
-        # and every node (consumer idle ticks pause with the barrier)
+        # and every node (consumer idle ticks pause with the barrier),
+        # plus the failure-containment plumbing: the CancelToken learns
+        # every channel, every node learns the token / dead-letter
+        # store / any bound fault-injection state
         from ..runtime.node import SourceLoopLogic, SourcePauseControl
         self._pause_ctl = SourcePauseControl()
+        fault_plan = getattr(self.config, "fault_plan", None)
         for n in self._all_nodes():
             n.pause_ctl = self._pause_ctl
+            n.cancel_token = self._cancel
+            n.dead_letters = self.dead_letters
+            if fault_plan is not None:
+                n.faults = fault_plan.for_node(n.name)
+            if n.channel is not None:
+                self._cancel.register(n.channel)
             if n.channel is None and isinstance(n.logic, SourceLoopLogic):
                 n.logic.pause_control = self._pause_ctl
         for n in self._all_nodes():
             n.start()
+        # watchdog AFTER the replica threads: it treats "no node alive"
+        # as graph completion, so starting it first would let it exit
+        # before the first node ever ran
+        if self.config.watchdog_timeout_s:
+            from ..resilience.watchdog import StallWatchdog
+            self._watchdog = StallWatchdog(
+                self, self.config.watchdog_timeout_s,
+                cancel=self.config.watchdog_cancel)
+            self._watchdog.start()
 
-    def wait_end(self) -> None:
-        errors = []
+    def cancel(self, reason: Optional[BaseException] = None) -> bool:
+        """Poison every channel: blocked replicas unwind and wait_end
+        returns.  Idempotent; returns False if already cancelled."""
+        return self._cancel.cancel(reason, origin="user")
+
+    def _join_all(self):
+        """Join every node; once the graph is cancelled, give each
+        remaining thread a bounded grace period (a replica stuck inside
+        user code cannot be killed from Python -- it is recorded as
+        stuck and abandoned; threads are daemonic).  Returns
+        (errors, stuck) lists."""
+        grace = self.config.cancel_grace_s
+        errors, stuck = [], []
         for n in self._all_nodes():
-            n.join()
+            grace_deadline = None
+            while n.is_alive():
+                n.join(timeout=0.1)
+                if not n.is_alive():
+                    break
+                if self._cancel.cancelled:
+                    now = _time.monotonic()
+                    if grace_deadline is None:
+                        grace_deadline = now + grace
+                    elif now > grace_deadline:
+                        stuck.append(n.name)
+                        break
             if n.error is not None:
                 errors.append((n.name, n.error))
+        return errors, stuck
+
+    def wait_end(self) -> None:
+        errors, stuck = self._join_all()
         self._ended = True
+        if self._watchdog is not None:
+            self._watchdog.stop()
         if self._monitor is not None:
             self._monitor.stop()
         if self.config.tracing:
@@ -213,9 +264,17 @@ class PipeGraph:
         if self.config.trace_runtime:
             self._dump_runtime_stats()
         if errors:
-            name, err = errors[0]
+            err = NodeFailureError.from_pairs(errors, stuck)
+            raise err from errors[0][1]
+        if self._cancel.cancelled:
+            # cancelled without any replica error: a watchdog stall or
+            # a user cancel() -- surface the recorded reason
+            reason = self._cancel.reason
+            if isinstance(reason, BaseException):
+                raise reason
             raise NodeFailureError(
-                f"node {name} failed: {err!r}") from err
+                f"graph {self.name!r} was cancelled "
+                f"(origin: {self._cancel.origin})")
 
     def _dump_runtime_stats(self) -> None:
         """Raw channel stats per consumer node (the -DTRACE_FASTFLOW
@@ -254,7 +313,8 @@ class PipeGraph:
         os.makedirs(d, exist_ok=True)
         pid = os.getpid()
         with open(os.path.join(d, f"{pid}_{self.name}.json"), "w") as f:
-            f.write(self.stats.to_json(self.get_num_dropped_tuples()))
+            f.write(self.stats.to_json(self.get_num_dropped_tuples(),
+                                       self.dead_letters.count()))
         with open(os.path.join(d, f"{pid}_{self.name}.dot"), "w") as f:
             f.write(graph_to_dot(self))
         with open(os.path.join(d, f"{pid}_{self.name}.svg"), "w") as f:

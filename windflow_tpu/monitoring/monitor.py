@@ -132,7 +132,9 @@ class MonitoringThread(threading.Thread):
     def _stats_json(self) -> str:
         stats = getattr(self.graph, "stats", None)
         if stats is not None:
-            return stats.to_json(self.graph.get_num_dropped_tuples())
+            dls = getattr(self.graph, "dead_letters", None)
+            return stats.to_json(self.graph.get_num_dropped_tuples(),
+                                 dls.count() if dls is not None else 0)
         return "{}"
 
     # -- thread body -------------------------------------------------------

@@ -31,6 +31,9 @@ class StatsRecord:
     outputs_sent: int = 0
     bytes_sent: int = 0
     inputs_ignored: int = 0
+    # tuples whose svc raised under a skip/dead_letter error policy
+    # (resilience/policies.py); the replica stayed alive
+    svc_failures: int = 0
     # EWMA service times (microseconds), updated inline like
     # win_seq.hpp:499-509
     service_time_us: float = 0.0
@@ -57,6 +60,7 @@ class StatsRecord:
             "Outputs_sent": self.outputs_sent,
             "Bytes_sent": self.bytes_sent,
             "Inputs_ignored": self.inputs_ignored,
+            "Svc_failures": self.svc_failures,
             "Service_time_usec": round(self.service_time_us, 3),
             "Eff_Service_time_usec": round(self.eff_service_time_us, 3),
             "Device_launches": self.num_launches,
@@ -92,7 +96,8 @@ class GraphStats:
             self.records.setdefault(operator_name, []).append(rec)
         return rec
 
-    def to_json(self, dropped_tuples: int = 0) -> str:
+    def to_json(self, dropped_tuples: int = 0,
+                dead_letter_tuples: int = 0) -> str:
         with self.lock:
             ops = [
                 {
@@ -103,11 +108,18 @@ class GraphStats:
                 }
                 for name, replicas in self.records.items()
             ]
+            svc_failures = sum(r.svc_failures
+                               for rs in self.records.values() for r in rs)
         return json.dumps({
             "PipeGraph_name": self.graph_name,
             "Mode": "DEFAULT",
             "Backpressure": "ON",
             "Dropped_tuples": dropped_tuples,
+            # failure-containment counters (resilience/): tuples whose
+            # svc raised under a skip/dead_letter policy, and how many
+            # of those were quarantined in the dead-letter store
+            "Svc_failures": svc_failures,
+            "Dead_letter_tuples": dead_letter_tuples,
             "Memory_usage_KB": get_mem_usage_kb(),
             "Operator_number": len(ops),
             "Operators": ops,

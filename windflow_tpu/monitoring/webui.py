@@ -60,6 +60,7 @@ HTML_PAGE = """<!DOCTYPE html>
           padding: 8px 14px; min-width: 110px; }
   .tile .v { font-size: 20px; font-weight: 600; font-variant-numeric:
              tabular-nums; }
+  .tile .v.bad { color: var(--status-serious); }
   .tile .k { color: var(--text-secondary); font-size: 11px; }
   svg text { fill: var(--text-secondary); font: 11px system-ui, sans-serif; }
   .topo rect { fill: var(--surface-2); stroke: var(--grid); rx: 4; }
@@ -208,6 +209,7 @@ function opRow(op) {
     <td>${fmt(sum("Inputs_received"))}</td>
     <td>${fmt(sum("Outputs_sent"))}</td>
     <td>${fmt(sum("Inputs_ignored"))}</td>
+    <td>${fmt(sum("Svc_failures"))}</td>
     <td>${svc.toFixed(1)}</td>
     <td>${fmt(sum("Device_launches"))}</td>
     <td>${fmt(sum("Bytes_to_device"))}</td>
@@ -241,6 +243,10 @@ function render(apps) {
           <div class="k">results received</div></div>
         <div class="tile"><div class="v">${fmt(rep.Dropped_tuples || 0)}
           </div><div class="k">dropped tuples</div></div>
+        <div class="tile"><div class="v${num(rep.Svc_failures) ? " bad" : ""}">
+          ${fmt(rep.Svc_failures || 0)}</div>
+          <div class="k">svc failures
+          (${fmt(rep.Dead_letter_tuples || 0)} dead-lettered)</div></div>
         <div class="tile"><div class="v">${replicas}</div>
           <div class="k">replicas (${num(rep.Operator_number)} ops)</div></div>
         <div class="tile"><div class="v">
@@ -250,7 +256,7 @@ function render(apps) {
       ${a.diagram.trim().startsWith("<svg") ? svgImg(a.diagram) : topoSvg(parseDot(a.diagram))}
       <div class="spark-wrap">${sparkline(id, hist[id])}</div>
       <table><thead><tr><th>operator</th><th>par</th><th>in</th>
-        <th>out</th><th>ignored</th><th>svc &micro;s</th>
+        <th>out</th><th>ignored</th><th>fails</th><th>svc &micro;s</th>
         <th>launches</th><th>B&rarr;dev</th><th>B&larr;dev</th></tr>
       </thead><tbody>${ops.map(opRow).join("")}</tbody></table>
     </div>`;

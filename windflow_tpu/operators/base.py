@@ -43,6 +43,10 @@ class StageSpec:
     group_emitters: Optional[List[Emitter]] = None
     # per-group farm collectors (e.g. each inner PLQ's ordered collector)
     group_collectors: Optional[List[NodeLogic]] = None
+    # per-operator error policy ('fail'|'skip'|'dead_letter'), filled
+    # from the operator descriptor at wiring (resilience/policies.py);
+    # applies to the stage's replica nodes, never to collectors
+    error_policy: Optional[str] = None
 
 
 class Operator:
@@ -57,6 +61,9 @@ class Operator:
         self.routing = routing
         self.pattern = pattern
         self.used = False  # one operator object per graph position (ref basic_operator)
+        # per-tuple svc failure handling (resilience/policies.py);
+        # builders set it via .with_error_policy(...)
+        self.error_policy = "fail"
 
     # -- to be provided by subclasses --------------------------------------
     def stages(self) -> List[StageSpec]:

@@ -1,0 +1,176 @@
+"""Deterministic seeded fault-injection harness.
+
+Recovery paths that only fire under failure are untestable without a
+way to *cause* failure on demand.  A :class:`FaultPlan` describes, up
+front and reproducibly, which faults fire where:
+
+* ``crash_replica(node_substr, at_tuple)`` -- the matching replica
+  raises :class:`InjectedFailure` when it takes its Nth tuple (1-based),
+  simulating a mid-stream replica death;
+* ``delay_puts(node_substr, delay_s, every_n)`` -- the matching
+  replica sleeps before every Nth downstream put (seeded jitter),
+  simulating a slow consumer / full-channel backpressure window;
+* ``fail_native_build()`` -- the native toolchain probe is forced to
+  fail, exercising the pure-Python fallback (and its warning).
+
+Attach a plan via ``RuntimeConfig.fault_plan``; ``PipeGraph.start``
+binds per-node fault state (each node's counters are independent, so a
+plan is deterministic regardless of thread interleaving).  Use as a
+context manager to guarantee global faults (native build) are undone::
+
+    with FaultPlan(seed=7).crash_replica("map", at_tuple=50) as plan:
+        cfg = RuntimeConfig(fault_plan=plan)
+        ...
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import List, Optional
+
+
+class InjectedFailure(RuntimeError):
+    """Raised by a FaultPlan crash rule inside the replica loop."""
+
+
+# -- forced native-build failure (module-global: the native module probes
+# this from _build(), which can run before any graph exists) --------------
+_native_fail_lock = threading.Lock()
+_native_fail_count = 0
+
+
+def native_build_forced_to_fail() -> bool:
+    return _native_fail_count > 0
+
+
+def _reset_native_cache() -> None:
+    """Drop the cached native lib so the next probe re-runs _build()."""
+    from ..runtime import native as _native
+    with _native._lib_lock:
+        _native._lib = None
+
+
+def _arm_native_failure() -> None:
+    global _native_fail_count
+    with _native_fail_lock:
+        _native_fail_count += 1
+    _reset_native_cache()
+
+
+def _disarm_native_failure() -> None:
+    global _native_fail_count
+    with _native_fail_lock:
+        _native_fail_count = max(0, _native_fail_count - 1)
+    _reset_native_cache()
+
+
+class _CrashRule:
+    __slots__ = ("node_substr", "at_tuple", "message")
+
+    def __init__(self, node_substr: str, at_tuple: int, message: str):
+        self.node_substr = node_substr
+        self.at_tuple = at_tuple
+        self.message = message
+
+
+class _DelayRule:
+    __slots__ = ("node_substr", "delay_s", "every_n", "jitter_s")
+
+    def __init__(self, node_substr: str, delay_s: float, every_n: int,
+                 jitter_s: float):
+        self.node_substr = node_substr
+        self.delay_s = delay_s
+        self.every_n = every_n
+        self.jitter_s = jitter_s
+
+
+class NodeFaults:
+    """Per-replica fault state bound at graph start (own counters +
+    own seeded RNG, so injection is deterministic per node)."""
+
+    __slots__ = ("node_name", "crash", "delays", "_rng", "_emits")
+
+    def __init__(self, node_name: str, crash: Optional[_CrashRule],
+                 delays: List[_DelayRule], seed: int):
+        self.node_name = node_name
+        self.crash = crash
+        self.delays = delays
+        self._rng = random.Random((seed, node_name).__repr__())
+        self._emits = 0
+
+    def on_tuple(self, taken: int) -> None:
+        """Called by the replica loop with its 1-based take counter."""
+        c = self.crash
+        if c is not None and taken == c.at_tuple:
+            raise InjectedFailure(
+                f"{c.message} (node {self.node_name}, tuple {taken})")
+
+    def before_put(self) -> None:
+        """Called before each downstream emission."""
+        self._emits += 1
+        for d in self.delays:
+            if self._emits % d.every_n == 0:
+                time.sleep(d.delay_s
+                           + (self._rng.random() * d.jitter_s
+                              if d.jitter_s else 0.0))
+
+
+class FaultPlan:
+    """Seeded, declarative fault schedule for one (test) run."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._crashes: List[_CrashRule] = []
+        self._delays: List[_DelayRule] = []
+        self._native_armed = False
+
+    # -- declaration (chainable) --------------------------------------
+    def crash_replica(self, node_substr: str, at_tuple: int,
+                      message: str = "injected replica crash") -> "FaultPlan":
+        if at_tuple < 1:
+            raise ValueError("at_tuple is 1-based")
+        self._crashes.append(_CrashRule(node_substr, at_tuple, message))
+        return self
+
+    def delay_puts(self, node_substr: str, delay_s: float,
+                   every_n: int = 1, jitter_s: float = 0.0) -> "FaultPlan":
+        if every_n < 1:
+            raise ValueError("every_n must be >= 1")
+        self._delays.append(_DelayRule(node_substr, delay_s, every_n,
+                                       jitter_s))
+        return self
+
+    def fail_native_build(self) -> "FaultPlan":
+        """Force the native toolchain probe to fail from now until
+        ``deactivate()`` (or context-manager exit)."""
+        if not self._native_armed:
+            self._native_armed = True
+            _arm_native_failure()
+        return self
+
+    def deactivate(self) -> None:
+        if self._native_armed:
+            self._native_armed = False
+            _disarm_native_failure()
+
+    # -- binding (called by PipeGraph.start per node) ------------------
+    def for_node(self, node_name: str) -> Optional[NodeFaults]:
+        # collector nodes ("<stage>.coll<i>" / ".collector" / ".coll.g<g>",
+        # multipipe wiring) share their stage's name but are runtime
+        # plumbing, not operator replicas: rules never bind to them
+        if ".coll" in node_name.rsplit("/", 1)[-1]:
+            return None
+        crash = next((c for c in self._crashes
+                      if c.node_substr in node_name), None)
+        delays = [d for d in self._delays if d.node_substr in node_name]
+        if crash is None and not delays:
+            return None
+        return NodeFaults(node_name, crash, delays, self.seed)
+
+    # -- context manager ----------------------------------------------
+    def __enter__(self) -> "FaultPlan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.deactivate()

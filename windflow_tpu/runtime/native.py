@@ -41,6 +41,13 @@ _STAMP = _SO + ".cmd"
 
 
 def _build() -> Optional[str]:
+    # fault-injection hook (resilience/faults.py): tests force the
+    # toolchain probe to fail to exercise the pure-Python fallback
+    from ..resilience.faults import native_build_forced_to_fail
+    if native_build_forced_to_fail():
+        return None
+    if os.environ.get("WINDFLOW_NATIVE", "1") == "0":
+        return None  # CI pure-Python job: skip the toolchain entirely
     cmd_str = " ".join(_CMD)
     fresh = os.path.exists(_SO) and all(
         os.path.getmtime(_SO) >= os.path.getmtime(src) for src in _SRCS)
@@ -76,9 +83,14 @@ def get_lib():
         lib.wfn_channel_free.argtypes = [ctypes.c_void_p]
         lib.wfn_channel_register_producer.restype = ctypes.c_int
         lib.wfn_channel_register_producer.argtypes = [ctypes.c_void_p]
+        lib.wfn_channel_put.restype = ctypes.c_int
         lib.wfn_channel_put.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                         ctypes.c_size_t]
         lib.wfn_channel_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.wfn_channel_poison.argtypes = [ctypes.c_void_p]
+        lib.wfn_channel_drain.restype = ctypes.c_int
+        lib.wfn_channel_drain.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_size_t)]
         lib.wfn_channel_get_timed.restype = ctypes.c_int
         lib.wfn_channel_get_timed.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_size_t),
@@ -169,8 +181,8 @@ def native_available() -> bool:
 class NativeChannel:
     """Drop-in for runtime.queues.Channel backed by the C++ channel."""
 
-    __slots__ = ("lib", "ptr", "n_producers", "capacity", "puts", "gets",
-                 "high_watermark")
+    __slots__ = ("lib", "ptr", "n_producers", "capacity", "poisoned",
+                 "puts", "gets", "high_watermark")
 
     def __init__(self, capacity: int = 2048):
         self.lib = get_lib()
@@ -179,6 +191,7 @@ class NativeChannel:
         self.ptr = self.lib.wfn_channel_new(capacity)
         self.n_producers = 0
         self.capacity = capacity
+        self.poisoned = False
         # raw queue counters (TRACE_FASTFLOW analogue)
         self.puts = 0
         self.gets = 0
@@ -190,7 +203,12 @@ class NativeChannel:
 
     def put(self, producer_id: int, item: Any) -> None:
         ctypes.pythonapi.Py_IncRef(ctypes.py_object(item))
-        self.lib.wfn_channel_put(self.ptr, producer_id, id(item))
+        rc = self.lib.wfn_channel_put(self.ptr, producer_id, id(item))
+        if rc < 0:  # poisoned: the channel did not take ownership
+            ctypes.pythonapi.Py_DecRef(ctypes.py_object(item))
+            from ..resilience.cancel import GraphCancelled
+            raise GraphCancelled(f"native channel poisoned (producer "
+                                 f"{producer_id})")
         self.puts += 1
         d = self.lib.wfn_channel_size(self.ptr)
         if d > self.high_watermark:
@@ -209,6 +227,9 @@ class NativeChannel:
             rc = self.lib.wfn_channel_get_timed(
                 self.ptr, ctypes.byref(handle), ctypes.byref(cid),
                 max(1, int(timeout * 1000)))
+        if rc < 0:
+            from ..resilience.cancel import GraphCancelled
+            raise GraphCancelled("native channel poisoned")
         if rc == 2:
             return CHANNEL_TIMEOUT
         if not rc:
@@ -218,6 +239,11 @@ class NativeChannel:
         self.gets += 1
         return cid.value, obj
 
+    def poison(self) -> None:
+        """Graph-cancellation sentinel: wake and fail all blocked ends."""
+        self.poisoned = True
+        self.lib.wfn_channel_poison(self.ptr)
+
     def qsize(self) -> int:
         return self.lib.wfn_channel_size(self.ptr)
 
@@ -226,12 +252,9 @@ class NativeChannel:
             lib, ptr = getattr(self, "lib", None), getattr(self, "ptr", None)
             if lib is not None and ptr:
                 # drain remaining handles to avoid leaking references
+                # (drain works on poisoned channels too, unlike get)
                 handle = ctypes.c_size_t()
-                cid = ctypes.c_int()
-                while lib.wfn_channel_size(self.ptr):
-                    if not lib.wfn_channel_get(self.ptr, ctypes.byref(handle),
-                                               ctypes.byref(cid)):
-                        break
+                while lib.wfn_channel_drain(ptr, ctypes.byref(handle)):
                     obj = ctypes.cast(handle.value, ctypes.py_object).value
                     ctypes.pythonapi.Py_DecRef(ctypes.py_object(obj))
                 lib.wfn_channel_free(ptr)

@@ -11,10 +11,13 @@ Python threads here and by the native C++ executor when built.
 from __future__ import annotations
 
 import threading
+import time as _time
 import traceback
 from typing import Any, Callable, List, Optional, Sequence
 
 from ..core.tuples import SynthChunk
+from ..resilience.cancel import GraphCancelled
+from ..resilience.policies import POLICY_DEAD_LETTER, POLICY_FAIL
 from .queues import Channel, CHANNEL_TIMEOUT
 
 
@@ -205,6 +208,7 @@ class RtNode(threading.Thread):
         self.channel = channel
         self.outlets = list(outlets)
         self.error: Optional[BaseException] = None
+        self.cancelled = False  # unwound by graph cancellation, no error
         self.stats = None  # StatsRecord when tracing is enabled
         self.group = None  # complex-nesting group id (multipipe grouping)
         # drain detection for the live-checkpoint barrier: an item is
@@ -216,12 +220,75 @@ class RtNode(threading.Thread):
         # pausing -- any launch they start strictly precedes a barrier
         # drain pass only if no NEW ticks begin after the pause request
         self.pause_ctl = None
+        # failure containment (attached by PipeGraph.start): the graph
+        # CancelToken, this operator's error policy, the graph
+        # dead-letter store, and any bound fault-injection state
+        self.cancel_token = None
+        self.error_policy = POLICY_FAIL
+        self.dead_letters = None
+        self.faults = None
 
     def _emit(self, item: Any) -> None:
         if self.stats is not None:
             self.stats.outputs_sent += 1
+        if self.faults is not None:
+            self.faults.before_put()
         for o in self.outlets:
             o.send(item)
+
+    def _svc_guarded(self, item: Any, cid: int) -> None:
+        """One svc call under this node's error policy: 'fail' lets the
+        exception kill the replica (and cancel the graph); 'skip' and
+        'dead_letter' quarantine the offending tuple and keep going.
+        GraphCancelled and non-Exception BaseExceptions always
+        propagate -- a shutdown signal is not a tuple failure."""
+        stats = self.stats
+        try:
+            if stats is not None:
+                stats.inputs_received += 1
+                t0 = _time.perf_counter()
+                self.logic.svc(item, cid, self._emit)
+                stats.observe((_time.perf_counter() - t0) * 1e6)
+            else:
+                self.logic.svc(item, cid, self._emit)
+        except Exception as e:
+            if self.error_policy == POLICY_FAIL:
+                raise
+            if stats is not None:
+                stats.svc_failures += 1
+            if self.error_policy == POLICY_DEAD_LETTER \
+                    and self.dead_letters is not None:
+                self.dead_letters.add(self.name, item, e)
+
+    def _consume_loop(self) -> None:
+        # logics with an idle_tick hook (time-bounded device launches on
+        # stalled streams) take timed gets so the tick fires without input
+        tick = getattr(self.logic, "idle_tick", None)
+        accepts_chunks = getattr(self.logic, "accepts_synth_chunks", False)
+        faults = self.faults
+        channel = self.channel
+        while True:
+            got = (channel.get(timeout=0.025) if tick else channel.get())
+            if got is CHANNEL_TIMEOUT:
+                if not (self.pause_ctl is not None
+                        and self.pause_ctl.pausing):
+                    tick(self._emit)
+                continue
+            if got is None:
+                break
+            cid, item = got
+            if not accepts_chunks and isinstance(item, SynthChunk):
+                item = item.materialize()  # plane boundary
+            self.taken += 1
+            if faults is not None:
+                faults.on_tuple(self.taken)  # may raise InjectedFailure
+            try:
+                self._svc_guarded(item, cid)
+            finally:
+                # count failed tuples as done too: the quiesce barrier's
+                # in-flight detection must not see a skipped tuple as
+                # forever in flight
+                self.done += 1
 
     def run(self) -> None:
         try:
@@ -230,54 +297,39 @@ class RtNode(threading.Thread):
             self.logic.stats = self.stats
             self.logic.svc_init()
             if self.channel is not None:
-                stats = self.stats
-                # logics with an idle_tick hook (time-bounded device
-                # launches on stalled streams) take timed gets so the
-                # tick fires without input
-                tick = getattr(self.logic, "idle_tick", None)
-                accepts_chunks = getattr(self.logic,
-                                         "accepts_synth_chunks", False)
-                while True:
-                    got = (self.channel.get(timeout=0.025) if tick
-                           else self.channel.get())
-                    if got is CHANNEL_TIMEOUT:
-                        if not (self.pause_ctl is not None
-                                and self.pause_ctl.pausing):
-                            tick(self._emit)
-                        continue
-                    if got is None:
-                        break
-                    cid, item = got
-                    if not accepts_chunks and isinstance(item, SynthChunk):
-                        item = item.materialize()  # plane boundary
-                    self.taken += 1
-                    if stats is not None:
-                        import time as _time
-                        stats.inputs_received += 1
-                        t0 = _time.perf_counter()
-                        self.logic.svc(item, cid, self._emit)
-                        stats.observe((_time.perf_counter() - t0) * 1e6)
-                    else:
-                        self.logic.svc(item, cid, self._emit)
-                    self.done += 1
+                self._consume_loop()
             self.logic.eos_flush(self._emit)
             if self.stats is not None:
                 self.stats.set_terminated()
+        except GraphCancelled:
+            self.cancelled = True  # clean unwind, not a failure
         except BaseException as e:  # surfaced by PipeGraph.wait_end
             self.error = e
             traceback.print_exc()
+            # poison every channel of the graph so blocked peers unwind
+            # instead of deadlocking on this dead replica's channel
+            if self.cancel_token is not None:
+                self.cancel_token.cancel(e, origin=self.name)
         finally:
             # svc_end BEFORE closing outlets: teardown hooks (e.g. the
             # device dispatcher abort) must stop emitting before the EOS
             # sentinel is enqueued downstream
             try:
                 self.logic.svc_end()
+            except GraphCancelled:
+                self.cancelled = True
             except BaseException as e:
                 if self.error is None:
                     self.error = e
+                    if self.cancel_token is not None:
+                        self.cancel_token.cancel(e, origin=self.name)
                 traceback.print_exc()
-            for o in self.outlets:
-                o.flush_eos()
+            try:
+                for o in self.outlets:
+                    o.flush_eos()
+            except GraphCancelled:
+                # downstream already poisoned: nobody is listening
+                self.cancelled = True
 
 
 class SourceLoopLogic(NodeLogic):

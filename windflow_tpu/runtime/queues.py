@@ -6,15 +6,25 @@ plane uses bounded MPSC channels with per-producer EOS accounting.  A
 consumer node owns exactly one channel; each upstream replica is a
 registered producer.  Backpressure = blocking bounded put (the analogue
 of FF_BOUNDED_BUFFER).  When the native C++ runtime is built
-(native/spsc.cpp), channels transparently use its ring buffers.
+(native/windflow_native.cpp), channels transparently use its ring
+buffers.
+
+Failure containment (resilience/): every channel supports ``poison()``
+-- the graph-wide shutdown sentinel.  A poisoned channel wakes every
+blocked ``put``/``get`` and makes them raise
+:class:`~windflow_tpu.resilience.GraphCancelled`, so a dead replica
+can never strand its upstream producers on a full bounded buffer.
 """
 from __future__ import annotations
 
-import queue as _queue
 import threading
+import time as _time
+import warnings
+from collections import deque
 from typing import Any, List, Optional, Tuple
 
 from ..core.basic import DEFAULT_QUEUE_CAPACITY
+from ..resilience.cancel import GraphCancelled
 
 _EOS_SENTINEL = object()
 
@@ -29,19 +39,27 @@ class Channel:
     Items are ``(producer_id, payload)``.  ``close(producer_id)`` enqueues
     an EOS token for that producer; ``get()`` returns ``None`` once every
     registered producer has closed (the FastFlow EOS-propagation analogue).
+    ``poison()`` cancels the channel: blocked and future put/get raise
+    GraphCancelled (close becomes a no-op -- the consumer is gone).
     """
 
-    __slots__ = ("q", "n_producers", "_eos_seen", "_lock", "capacity",
+    __slots__ = ("_items", "_lock", "_not_empty", "_not_full",
+                 "n_producers", "_eos_seen", "capacity", "poisoned",
                  "puts", "gets", "high_watermark")
 
     def __init__(self, capacity: int = DEFAULT_QUEUE_CAPACITY):
-        self.q: _queue.Queue = _queue.Queue(maxsize=capacity)
+        self._items: deque = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
         self.n_producers = 0
         self._eos_seen = 0
-        self._lock = threading.Lock()
-        self.capacity = capacity
-        # raw queue counters (TRACE_FASTFLOW analogue); puts/hwm written
-        # under the producer's put, gets by the single consumer
+        # 0 (or negative) = unbounded, matching queue.Queue(maxsize=0)
+        # which this class replaced
+        self.capacity = capacity if capacity > 0 else None
+        self.poisoned = False
+        # raw queue counters (TRACE_FASTFLOW analogue); tracing-grade
+        # under concurrent producers
         self.puts = 0
         self.gets = 0
         self.high_watermark = 0
@@ -53,35 +71,87 @@ class Channel:
             return pid
 
     def put(self, producer_id: int, item: Any) -> None:
-        self.q.put((producer_id, item))
-        self.puts += 1
-        d = self.q.qsize()
-        if d > self.high_watermark:
-            self.high_watermark = d
+        with self._not_full:
+            while self.capacity is not None \
+                    and len(self._items) >= self.capacity \
+                    and not self.poisoned:
+                self._not_full.wait()
+            if self.poisoned:
+                raise GraphCancelled(f"channel poisoned (producer "
+                                     f"{producer_id})")
+            self._items.append((producer_id, item))
+            self.puts += 1
+            d = len(self._items)
+            if d > self.high_watermark:
+                self.high_watermark = d
+            self._not_empty.notify()
 
     def close(self, producer_id: int) -> None:
-        self.q.put((producer_id, _EOS_SENTINEL))
+        # EOS bypasses the capacity bound (like the native channel): a
+        # producer must always be able to announce its end of stream
+        with self._lock:
+            if self.poisoned:
+                return
+            self._items.append((producer_id, _EOS_SENTINEL))
+            self._not_empty.notify()
 
     def get(self, timeout: Optional[float] = None):
         """Next (channel_id, item); None when all producers closed;
         CHANNEL_TIMEOUT when ``timeout`` seconds pass with nothing to
-        deliver (idle-tick consumers)."""
-        while True:
-            try:
-                pid, item = (self.q.get(timeout=timeout)
-                             if timeout is not None else self.q.get())
-            except _queue.Empty:
-                return CHANNEL_TIMEOUT
-            if item is _EOS_SENTINEL:
-                self._eos_seen += 1
-                if self._eos_seen >= self.n_producers:
-                    return None
-                continue
-            self.gets += 1
-            return pid, item
+        deliver (idle-tick consumers).  Raises GraphCancelled once the
+        channel is poisoned."""
+        with self._not_empty:
+            deadline = (None if timeout is None
+                        else _time.monotonic() + timeout)
+            while True:
+                while not self._items:
+                    if self.poisoned:
+                        raise GraphCancelled("channel poisoned")
+                    if deadline is None:
+                        self._not_empty.wait()
+                    else:
+                        remaining = deadline - _time.monotonic()
+                        if remaining <= 0:
+                            return CHANNEL_TIMEOUT
+                        self._not_empty.wait(remaining)
+                if self.poisoned:
+                    raise GraphCancelled("channel poisoned")
+                pid, item = self._items.popleft()
+                self._not_full.notify()
+                if item is _EOS_SENTINEL:
+                    self._eos_seen += 1
+                    if self._eos_seen >= self.n_producers:
+                        return None
+                    continue
+                self.gets += 1
+                return pid, item
+
+    def poison(self) -> None:
+        """Graph-cancellation sentinel: wake and fail all blocked ends."""
+        with self._lock:
+            self.poisoned = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
 
     def qsize(self) -> int:
-        return self.q.qsize()
+        with self._lock:
+            return len(self._items)
+
+
+_native_warned = False
+
+
+def _warn_native_unavailable(detail: str) -> None:
+    """One warning per process: a broken native toolchain should be
+    visible, not silently degrade every channel to pure Python."""
+    global _native_warned
+    if _native_warned:
+        return
+    _native_warned = True
+    warnings.warn(
+        f"windflow_tpu native runtime unavailable ({detail}); falling "
+        "back to pure-Python channels (set use_native_runtime=False or "
+        "WINDFLOW_NATIVE=0 to silence)", RuntimeWarning, stacklevel=3)
 
 
 def make_channel(config=None) -> "Channel":
@@ -93,6 +163,13 @@ def make_channel(config=None) -> "Channel":
             from .native import NativeChannel, native_available
             if native_available():
                 return NativeChannel(cap)
-        except Exception:
-            pass
+            import os
+            if os.environ.get("WINDFLOW_NATIVE", "1") != "0":
+                # deliberate WINDFLOW_NATIVE=0 runs fall through
+                # silently; only a genuinely broken toolchain warns
+                _warn_native_unavailable("toolchain probe/build failed")
+        except (OSError, RuntimeError) as e:
+            # only environment errors are expected here; anything else
+            # (a real bug in the binding layer) must propagate
+            _warn_native_unavailable(repr(e))
     return Channel(cap)

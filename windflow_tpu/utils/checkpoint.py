@@ -89,7 +89,7 @@ def restore_graph(graph, path: str) -> int:
 
 
 def run_with_recovery(graph_factory, checkpoint_path: str,
-                      max_restarts: int = 3) -> Any:
+                      max_restarts: int = 3, on_failure=None) -> Any:
     """Failure-recovery policy runner (the recovery layer the reference
     lacks entirely, SURVEY.md §5 "failure detection / elastic
     recovery: Absent").
@@ -103,6 +103,19 @@ def run_with_recovery(graph_factory, checkpoint_path: str,
     run()-quiescent state, or seeded by the caller -- is restored into a
     freshly built graph and the run retries, up to ``max_restarts``.
 
+    The failure-containment layer (resilience/; docs/RESILIENCE.md)
+    makes this runner reach its retry path for *mid-stream* crashes
+    too: graph cancellation guarantees ``wait_end`` returns (no
+    full-channel deadlock) and a configured stall watchdog converts
+    hangs into ``StallError`` (a ``NodeFailureError`` subclass, so
+    stalled runs are retried as well).
+
+    ``on_failure(attempt, error, graph)``, when given, observes every
+    failed attempt before the retry -- e.g. to drain
+    ``graph.dead_letters`` or emit alerts.  The failures of all
+    attempts are attached to the finally raised error as
+    ``error.attempt_history``.
+
     Checkpoints are only taken at quiescent points (this runner
     checkpoints AFTER a successful run; mid-stream snapshots require
     the caller to stage input so a replayed attempt re-feeds unacked
@@ -113,6 +126,7 @@ def run_with_recovery(graph_factory, checkpoint_path: str,
     """
     import os
     attempt = 0
+    history: List[BaseException] = []
     while True:
         g = graph_factory(attempt)
         if attempt > 0 and os.path.exists(checkpoint_path):
@@ -121,11 +135,15 @@ def run_with_recovery(graph_factory, checkpoint_path: str,
             g.run()
             save_graph(g, checkpoint_path)
             return g
-        except NodeFailureError:
+        except NodeFailureError as e:
             # only replica-thread deaths are retried; deterministic
             # graph-construction/validation errors (plain RuntimeError
             # from merge checks etc.) re-raise immediately instead of
             # silently re-running the full source stream
+            history.append(e)
+            if on_failure is not None:
+                on_failure(attempt, e, g)
             attempt += 1
             if attempt > max_restarts:
+                e.attempt_history = history
                 raise
