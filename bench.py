@@ -247,6 +247,80 @@ def run_win_seq_tpu(n_events, source_batch=None, delay_ms=10.0,
     return n_events / dt, sink.windows, dt, sink.lats
 
 
+class _IngestLatencySink:
+    """Counting sink measuring window-result latency for the ingest
+    feed: birth = the ingest-plane emission stamp of the chunk carrying
+    the window's closing tuple (the replay source records cumulative
+    raw tuples emitted per ship), emission = arrival here."""
+
+    def __init__(self, stamps_fn):
+        from windflow_tpu.core.tuples import TupleBatch
+        self._TB = TupleBatch
+        self.stamps_fn = stamps_fn    # lazy: logics exist after wiring
+        self.lock = threading.Lock()
+        self.windows = 0
+        self.total = 0.0
+        self.lats = []
+
+    def __call__(self, item):
+        if item is None:
+            return
+        now = time.perf_counter()
+        with self.lock:
+            if not isinstance(item, self._TB):
+                self.windows += 1
+                self.total += item.value
+                return
+            self.windows += len(item)
+            self.total += float(item["value"].sum())
+            stamps = self.stamps_fn()
+            if len(self.lats) >= 200_000 or not stamps:
+                return
+            cums = np.asarray([s[0] for s in stamps])
+            ts = np.asarray([s[1] for s in stamps])
+            # closing tuple of TB window g (identity config, delay 0) is
+            # raw event (g*SLIDE + WIN - 1)*N_KEYS + key of the trace
+            closing = (item.id * SLIDE + (WIN - 1)) * N_KEYS + item.key
+            idx = np.minimum(np.searchsorted(cums, closing, side="right"),
+                             len(cums) - 1)
+            self.lats.extend((now - ts[idx]).tolist())
+
+
+def run_ingest_feed(n_events, latency_target_ms=50.0):
+    """Config #2g: replay-trace feed through the adaptive ingest plane
+    (ingest/: credit-gated replay source, AIMD microbatch controller,
+    native pane pre-reduction) into the same WinSeqTPU engine as #2f.
+    The trace is materialized up front -- the source replays recorded
+    columns, the operating point external feeds pay once the ingest
+    plane, not per-tuple Python, owns admission."""
+    import windflow_tpu as wf
+    from windflow_tpu.core.tuples import TupleBatch
+    from windflow_tpu.operators.basic_ops import Sink
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
+
+    arange = np.arange(n_events, dtype=np.int64)
+    ids = arange // N_KEYS
+    trace = TupleBatch({
+        "key": arange % N_KEYS, "id": ids, "ts": ids,
+        "value": np.random.default_rng(0).random(n_events).astype(
+            np.float32)})
+    src = wf.SourceBuilder.from_replay(trace, speedup=None, chunk=None) \
+        .with_microbatch(1 << 19).with_credits(1 << 21).build()
+    cfg = wf.RuntimeConfig(latency_target_ms=latency_target_ms)
+    g = wf.PipeGraph("bench2g", wf.Mode.DEFAULT, config=cfg)
+    op = WinSeqTPU("sum", WIN, SLIDE, wf.WinType.TB,
+                   batch_len=DEVICE_BATCH, emit_batches=True,
+                   max_buffer_elems=MAX_BUFFER, inflight_depth=INFLIGHT)
+    sink = _IngestLatencySink(lambda: src.logics[0].emit_stamps)
+    g.add_source(src).add(op).add_sink(Sink(sink))
+    t0 = time.perf_counter()
+    g.run()
+    dt = time.perf_counter() - t0
+    metrics = src.logics[0].metrics()
+    return (n_events / dt, sink.windows, src.shed_count(), sink.lats,
+            metrics)
+
+
 def run_cpu_chain(n_events):
     """Config #1: declared map->filter->keyed window chain on the host
     plane.  Graph lowering folds the declared chain into the columnar
@@ -496,6 +570,19 @@ def main():
     configs["2f_win_seq_tpu_feed"] = {
         "rate": round(rate2f, 1), "windows": w2f,
         "vs_baseline": _vs(rate2f)}
+    # ingest-plane feed: the same engine driven through the adaptive
+    # ingestion plane (replay source + credits + AIMD controller + pane
+    # pre-reduction) -- tracks the ingest plane's gap to the fused lane
+    rate2g, w2g, shed2g, lat_g, ing_m = run_ingest_feed(16_000_000)
+    p50g, p99g = _pcts(lat_g)
+    configs["2g_ingest_feed"] = {
+        "rate": round(rate2g, 1), "windows": w2g,
+        "shed_tuples": shed2g,
+        "window_latency_p50_ms": p50g, "window_latency_p99_ms": p99g,
+        "vs_baseline": _vs(rate2g),
+        "vs_feed": round(rate2g / rate2f, 2),
+        "controller_batch_final": ing_m["batch_size"],
+        "credit_waits": ing_m["credit_waits"]}
     # configs 3/4 run the same workload as the baseline, so they carry
     # vs_baseline too; 5/6 are different workloads (no ratio)
     rate3, w3 = run_pane_farm_tpu(32_000_000)

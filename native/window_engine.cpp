@@ -838,3 +838,86 @@ int wfn_engine_deserialize(void* ep, const unsigned char* buf, i64 len) {
 }
 
 }  // extern "C"
+
+namespace {
+
+// Ingest-plane pane pre-reduction (windflow_tpu/ingest/coalesce.py):
+// collapse one columnar chunk to per-(key, pane) sum partials over a
+// dense grid, fused min/max scan + accumulate in two passes.  Values
+// fold in arrival order, exactly like the engine's own pane ring.
+// floor division (numpy's //): the Python fallback floors, and a
+// negative timestamp must land in its containing pane, not pane 0
+static inline i64 floordiv(i64 a, i64 b) {
+    i64 q = a / b;
+    return (a % b != 0 && ((a < 0) != (b < 0))) ? q - 1 : q;
+}
+
+template <typename V>
+i64 pane_prereduce_impl(const i64* keys, const i64* tss, const V* vals,
+                        i64 n, i64 pane, i64 cap, i64* out_keys,
+                        i64* out_panes, double* out_sums) {
+    if (n <= 0) return 0;
+    i64 kmin = keys[0], kmax = keys[0], bmin = tss[0], bmax = tss[0];
+    for (i64 i = 1; i < n; ++i) {
+        const i64 k = keys[i], t = tss[i];
+        if (k < kmin) kmin = k; else if (k > kmax) kmax = k;
+        if (t < bmin) bmin = t; else if (t > bmax) bmax = t;
+    }
+    bmin = floordiv(bmin, pane);
+    bmax = floordiv(bmax, pane);
+    // range spans in UNSIGNED arithmetic: wire-fed key/ts columns can
+    // legitimately span most of int64 (codec frames are unvalidated),
+    // and (kmax - kmin + 1) in signed math would be UB the optimizer
+    // may exploit to delete the guards below
+    const uint64_t ukr = (uint64_t)kmax - (uint64_t)kmin;
+    const uint64_t ubr = (uint64_t)bmax - (uint64_t)bmin;
+    // sparse key/pane domain: a dense grid would be allocation-bound.
+    // Comparisons are span-based (no +1, no product) so nothing wraps.
+    if (ukr >= (uint64_t)(n + 1024)) return -1;
+    const i64 krange = (i64)ukr + 1;
+    if (ubr >= (uint64_t)((4 * n + 4096) / krange)) return -1;
+    const i64 brange = (i64)ubr + 1;
+    const i64 grid = krange * brange;
+    std::vector<double> sums((size_t)grid, 0.0);
+    std::vector<i64> counts((size_t)grid, 0);
+    for (i64 i = 0; i < n; ++i) {
+        const i64 idx = (floordiv(tss[i], pane) - bmin) * krange
+                        + (keys[i] - kmin);
+        sums[(size_t)idx] += (double)vals[i];
+        counts[(size_t)idx] += 1;
+    }
+    i64 m = 0;
+    for (i64 idx = 0; idx < grid; ++idx) {  // pane-major ascending order
+        if (counts[(size_t)idx] == 0) continue;
+        if (m >= cap) return -2;            // caller retries with more room
+        out_keys[m] = idx % krange + kmin;
+        out_panes[m] = (idx / krange + bmin) * pane;
+        out_sums[m] = sums[(size_t)idx];
+        ++m;
+    }
+    return m;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns the number of partials written, -1 when the key/pane domain
+// is too sparse for the dense grid (caller falls back), or -2 when
+// `cap` is too small (caller retries with a larger buffer).
+i64 wfn_pane_prereduce(const i64* keys, const i64* tss, const double* vals,
+                       i64 n, i64 pane, i64 cap, i64* out_keys,
+                       i64* out_panes, double* out_sums) {
+    return pane_prereduce_impl(keys, tss, vals, n, pane, cap, out_keys,
+                               out_panes, out_sums);
+}
+
+i64 wfn_pane_prereduce_f32(const i64* keys, const i64* tss,
+                           const float* vals, i64 n, i64 pane, i64 cap,
+                           i64* out_keys, i64* out_panes,
+                           double* out_sums) {
+    return pane_prereduce_impl(keys, tss, vals, n, pane, cap, out_keys,
+                               out_panes, out_sums);
+}
+
+}  // extern "C"

@@ -470,7 +470,6 @@ def test_chained_logic_checkpoints_both_halves():
     node stateless."""
     from windflow_tpu.core.basic import OptLevel, WinType
     from windflow_tpu.operators.pane_farm import PaneFarm
-    from windflow_tpu.runtime.node import ChainedLogic
     import windflow_tpu as wf
 
     def fsum(gwid, it, res):

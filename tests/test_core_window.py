@@ -4,7 +4,6 @@ The reference has no unit tests for these (SURVEY.md §4); windflow_tpu
 tests them directly since the determinism oracles hinge on this math.
 """
 import numpy as np
-import pytest
 
 from windflow_tpu.core import (BasicRecord, TriggererCB, TriggererTB, Window,
                                WinEvent, WinType, WinOperatorConfig, Role)

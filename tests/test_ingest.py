@@ -1,0 +1,468 @@
+"""Ingestion plane (windflow_tpu/ingest/; docs/INGEST.md): sources,
+credit-based backpressure, admission control and the adaptive
+microbatch controller."""
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core.basic import RuntimeConfig
+from windflow_tpu.core.tuples import TupleBatch
+from windflow_tpu.ingest import (MicrobatchController, ShedTuples,
+                                 StreamDecoder, encode_batch)
+from windflow_tpu.ingest.coalesce import PanePreReducer
+from windflow_tpu.operators.basic_ops import Sink
+from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
+
+
+def make_trace(n, n_keys=4, seed=0, value=None):
+    ar = np.arange(n, dtype=np.int64)
+    ids = ar // n_keys
+    vals = (np.full(n, value, np.float64) if value is not None
+            else np.random.default_rng(seed).random(n))
+    return TupleBatch({"key": ar % n_keys, "id": ids, "ts": ids,
+                       "value": vals})
+
+
+class BatchSink:
+    def __init__(self, delay_s=0.0):
+        self.lock = threading.Lock()
+        self.batches = []
+        self.tuples = 0
+        self.total = 0.0
+        self.delay_s = delay_s
+
+    def __call__(self, item):
+        if item is None:
+            return
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        with self.lock:
+            self.batches.append(item)
+            self.tuples += len(item)
+            self.total += float(item["value"].sum())
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+def test_codec_roundtrip_fragmented():
+    b1 = make_trace(1000, n_keys=3, seed=1)
+    b2 = make_trace(17, n_keys=2, seed=2).with_cols(
+        extra=np.arange(17, dtype=np.int64))
+    wire = encode_batch(b1) + encode_batch(b2)
+    dec = StreamDecoder()
+    out = []
+    for i in range(0, len(wire), 997):   # deliberately misaligned chunks
+        out.extend(dec.feed(wire[i:i + 997]))
+    assert len(out) == 2
+    np.testing.assert_array_equal(out[0].key, b1.key)
+    np.testing.assert_allclose(out[0]["value"], b1["value"])
+    np.testing.assert_array_equal(out[1]["extra"], b2["extra"])
+    assert dec.pending_bytes() == 0
+
+
+def test_codec_rejects_bad_magic():
+    dec = StreamDecoder()
+    with pytest.raises(ValueError, match="magic"):
+        dec.feed(b"XXXX" + b"\x00" * 16)
+
+
+# ---------------------------------------------------------------------------
+# replay source
+# ---------------------------------------------------------------------------
+
+def test_replay_source_end_to_end_no_shed():
+    n = 100_000
+    trace = make_trace(n, value=1.0)
+    src = wf.SourceBuilder.from_replay(trace, speedup=None,
+                                       chunk=8192).build()
+    sink = BatchSink()
+    g = wf.PipeGraph("replay_e2e", wf.Mode.DEFAULT)
+    g.add_source(src).add_sink(Sink(sink))
+    g.run()
+    assert sink.tuples == n
+    assert sink.total == float(n)
+    assert src.shed_count() == 0           # nominal load never sheds
+    assert g.dead_letters.count() == 0
+    m = src.metrics()[0]
+    assert m["raw_emitted"] == n
+    assert m["credits_peak_outstanding"] <= m["credits_budget"]
+
+
+def test_replay_deterministic_under_seed():
+    trace = make_trace(20_000, n_keys=3, seed=5)
+
+    def run_once():
+        src = wf.SourceBuilder.from_replay(trace, speedup=None,
+                                           chunk=1024, seed=7).build()
+        sink = BatchSink()
+        g = wf.PipeGraph("replay_det", wf.Mode.DEFAULT)
+        g.add_source(src).add_sink(Sink(sink))
+        g.run()
+        return np.concatenate([b["value"] for b in sink.batches])
+
+    a, b = run_once(), run_once()
+    np.testing.assert_array_equal(a, b)    # content and order reproduce
+
+
+def test_replay_speedup_paces_emission():
+    n = 2_000
+    trace = make_trace(n, n_keys=1)        # ts spans 0..1999
+    # 2000 ts units at 1 ms/unit = 2 s span; speedup 10 => ~0.2 s
+    src = wf.SourceBuilder.from_replay(trace, speedup=10.0, ts_unit_s=1e-3,
+                                       chunk=500).build()
+    sink = BatchSink()
+    g = wf.PipeGraph("replay_pace", wf.Mode.DEFAULT)
+    g.add_source(src).add_sink(Sink(sink))
+    t0 = time.monotonic()
+    g.run()
+    dt = time.monotonic() - t0
+    assert sink.tuples == n
+    assert dt >= 0.1                       # rate control actually slept
+
+
+def test_replay_composes_with_fault_plan():
+    from windflow_tpu.resilience import FaultPlan, InjectedFailure
+    trace = make_trace(50_000)
+    plan = FaultPlan(seed=3).crash_replica("sink", at_tuple=2)
+    src = wf.SourceBuilder.from_replay(trace, speedup=None,
+                                       chunk=4096).build()
+    g = wf.PipeGraph("replay_fault", wf.Mode.DEFAULT,
+                     config=RuntimeConfig(fault_plan=plan))
+    g.add_source(src).add_sink(Sink(BatchSink()))
+    t0 = time.monotonic()
+    with pytest.raises(wf.NodeFailureError) as ei:
+        g.run()
+    assert time.monotonic() - t0 < 30      # source unblocked, no hang
+    assert any(isinstance(e, InjectedFailure) for _, e in ei.value.errors)
+
+
+# ---------------------------------------------------------------------------
+# socket source: credits throttle a slow consumer, cancel unblocks recv
+# ---------------------------------------------------------------------------
+
+def _serve(batches):
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def run():
+        conn, _ = srv.accept()
+        try:
+            for b in batches:
+                conn.sendall(encode_batch(b))
+        except OSError:
+            pass
+        finally:
+            conn.close()
+            srv.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return port, t
+
+
+def test_socket_source_slow_consumer_throttled_by_credits():
+    n_batches, per = 40, 1000
+    batches = [make_trace(per, seed=i, value=1.0) for i in range(n_batches)]
+    port, _t = _serve(batches)
+    budget = 2048
+    src = wf.SourceBuilder.from_socket("127.0.0.1", port) \
+        .with_credits(budget).build()
+    sink = BatchSink(delay_s=0.01)         # deliberately slow consumer
+    cfg = RuntimeConfig(watchdog_timeout_s=30.0)  # deadlock tripwire
+    g = wf.PipeGraph("sock_slow", wf.Mode.DEFAULT, config=cfg)
+    g.add_source(src).add_sink(Sink(sink))
+    g.run()                                # no deadlock under the watchdog
+    assert sink.tuples == n_batches * per
+    assert sink.total == float(n_batches * per)
+    m = src.metrics()[0]
+    # bounded buffering: outstanding credits never exceed the budget
+    # (+1 batch can be mid-flight in the stage, also bounded)
+    assert m["credits_peak_outstanding"] <= budget
+    assert m["peak_staged"] <= budget
+    assert m["credit_waits"] > 0           # exhaustion actually throttled
+    assert src.shed_count() == 0           # backpressure, not loss
+
+
+def test_credits_balance_across_parallel_consumers():
+    # credits are charged per delivery (CreditedChannel.put), so a
+    # round-robin emitter into N consumer channels and a multicast
+    # split keep the books balanced -- no phantom outstanding credits
+    # (deadlock), no double releases (unbounded buffering)
+    n = 30_000
+    trace = make_trace(n, value=1.0)
+    src = wf.SourceBuilder.from_replay(trace, speedup=None, chunk=512) \
+        .with_credits(2048).build()
+    sink = BatchSink(delay_s=0.002)
+    g = wf.PipeGraph("par_consumers", wf.Mode.DEFAULT,
+                     config=RuntimeConfig(watchdog_timeout_s=30.0))
+    g.add_source(src).add_sink(Sink(sink, parallelism=2))
+    g.run()
+    assert sink.tuples == n
+    m = src.metrics()[0]
+    assert m["credits_peak_outstanding"] <= 2048
+    assert m["credits_available"] == 2048   # every spend was released
+
+
+def test_socket_source_cancel_unblocks_mid_recv():
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    threading.Thread(target=lambda: srv.accept(), daemon=True).start()
+    src = wf.SourceBuilder.from_socket("127.0.0.1", port).build()
+    g = wf.PipeGraph("sock_cancel", wf.Mode.DEFAULT)
+    g.add_source(src).add_sink(Sink(BatchSink()))
+    g.start()
+    time.sleep(0.3)                        # source parked in recv timeout
+    g.cancel()
+    t0 = time.monotonic()
+    with pytest.raises(wf.NodeFailureError):
+        g.wait_end()
+    assert time.monotonic() - t0 < 10
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def _run_overloaded(policy, n=60_000, budget=1024):
+    trace = make_trace(n, value=1.0)
+    src = wf.SourceBuilder.from_replay(trace, speedup=None, chunk=512) \
+        .with_credits(budget).with_admission(policy, max_wait_ms=0,
+                                             seed=11).build()
+    sink = BatchSink(delay_s=0.005)        # consumer far slower than replay
+    cfg = RuntimeConfig(tracing=True, watchdog_timeout_s=60.0)
+    g = wf.PipeGraph(f"adm_{policy}", wf.Mode.DEFAULT, config=cfg)
+    g.add_source(src).add_sink(Sink(sink))
+    g.run()
+    return g, src, sink, n
+
+
+@pytest.mark.parametrize("policy", ["drop_newest", "drop_oldest", "sample"])
+def test_admission_policy_sheds_into_dead_letters(policy):
+    g, src, sink, n = _run_overloaded(policy)
+    shed = src.shed_count()
+    assert shed > 0                        # overload actually shed
+    # conservation: every tuple either reached the sink or was shed
+    assert sink.tuples + shed == n
+    # shed tuples are quarantined with exact counts
+    assert g.dead_letters.count() == shed
+    by_node = g.dead_letters.counts_by_node()
+    assert sum(by_node.values()) == shed
+    assert all("replay" in k for k in by_node)
+    assert any(isinstance(e.error, ShedTuples)
+               for e in g.dead_letters.entries)
+    # counters surfaced in the stats JSON (dashboard payload)
+    data = json.loads(g.stats.to_json(
+        g.get_num_dropped_tuples(), g.dead_letters.count()))
+    assert data["Shed_tuples"] == shed
+    assert data["Dead_letter_tuples"] == shed
+    replay_op = next(o for o in data["Operators"]
+                     if "replay" in o["Operator_name"])
+    assert sum(r["Shed_tuples"] for r in replay_op["Replicas"]) == shed
+
+
+# ---------------------------------------------------------------------------
+# microbatch controller (AIMD)
+# ---------------------------------------------------------------------------
+
+def test_controller_aimd_shape():
+    mc = MicrobatchController(latency_target_ms=10.0, min_batch=128,
+                              max_batch=8192, initial_batch=1024,
+                              adjust_interval_s=0.0)
+    b0 = mc.batch_size
+    mc.observe(0.001)                      # under budget: additive increase
+    assert mc.batch_size > b0
+    grown = mc.batch_size
+    mc.observe(0.5)                        # over budget: halve
+    assert mc.batch_size == max(128, grown // 2)
+    for _ in range(64):                    # MD floors at min_batch
+        mc.observe(0.5)
+    assert mc.batch_size == 128
+    for _ in range(256):                   # AI caps at max_batch
+        mc.observe(0.001)
+    assert mc.batch_size == 8192
+    assert len(mc.trace) > 2               # decision trace recorded
+
+
+def test_controller_without_target_stays_static():
+    mc = MicrobatchController(latency_target_ms=None, initial_batch=2048,
+                              adjust_interval_s=0.0)
+    for lat in (0.001, 5.0, 0.2):
+        mc.observe(lat)
+    assert mc.batch_size == 2048
+
+
+def test_controller_steers_engine_launch_delay():
+    trace = make_trace(50_000, value=1.0)
+    src = wf.SourceBuilder.from_replay(trace, speedup=None,
+                                       chunk=4096).build()
+    cfg = RuntimeConfig(latency_target_ms=20.0)
+    g = wf.PipeGraph("steer", wf.Mode.DEFAULT, config=cfg)
+    op = WinSeqTPU("sum", 1024, 512, wf.WinType.TB, emit_batches=True,
+                   max_batch_delay_ms=10.0)
+    sink = BatchSink()
+    g.add_source(src).add(op).add_sink(Sink(sink))
+    g.run()
+    logic = src.logics[0]
+    assert logic.controller.latency_target_ms == 20.0
+    # wiring rewrote the engine's static launch bound to a fraction of
+    # the shared budget (20 * 0.25 = 5 < the configured 10)
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPULogic
+    eng = next(n.logic for n in g._all_nodes()
+               if isinstance(n.logic, WinSeqTPULogic))
+    assert eng.max_batch_delay_ms == pytest.approx(5.0)
+
+
+# ---------------------------------------------------------------------------
+# pane pre-reduction ("ship partials, not tuples" at the ingest edge)
+# ---------------------------------------------------------------------------
+
+def _window_results(pre_reduce, n=60_000, n_keys=4):
+    trace = make_trace(n, n_keys=n_keys, seed=9)
+    src = wf.SourceBuilder.from_replay(trace, speedup=None,
+                                       chunk=4096).build()
+    src.pre_reduce = pre_reduce
+    out = {}
+    lock = threading.Lock()
+
+    def sink(item):
+        if item is None:
+            return
+        with lock:
+            for i in range(len(item)):
+                out[(int(item.key[i]), int(item.id[i]))] = \
+                    float(item["value"][i])
+
+    g = wf.PipeGraph(f"prered_{pre_reduce}", wf.Mode.DEFAULT)
+    op = WinSeqTPU("sum", 2048, 1024, wf.WinType.TB, emit_batches=True)
+    g.add_source(src).add(op).add_sink(Sink(sink))
+    g.run()
+    return out, src
+
+
+def test_pane_prereduce_matches_raw_results():
+    a, src_a = _window_results("auto")
+    b, _ = _window_results(False)
+    assert src_a.logics[0].coalescer.pre_reduce is not None
+    assert set(a) == set(b) and len(a) > 50
+    for k in a:
+        assert a[k] == pytest.approx(b[k], rel=1e-9)
+    # the wire carried pane partials, not tuples
+    m = src_a.metrics()[0]
+    assert m["tuples_emitted"] < m["raw_emitted"] // 100
+
+
+def test_oversize_frame_does_not_deadlock_small_credit_budget():
+    # one transport frame larger than the whole stage cap / credit
+    # budget must flow through (admitted once the stage drains), never
+    # deadlock -- regression for the min(n, budget) rule at the stage
+    n_batches, per, budget = 6, 7000, 2048
+    batches = [make_trace(per, seed=i, value=1.0) for i in range(n_batches)]
+    port, _t = _serve(batches)
+    src = wf.SourceBuilder.from_socket("127.0.0.1", port) \
+        .with_credits(budget).build()
+    sink = BatchSink()
+    g = wf.PipeGraph("sock_oversize", wf.Mode.DEFAULT,
+                     config=RuntimeConfig(watchdog_timeout_s=30.0))
+    g.add_source(src).add_sink(Sink(sink))
+    g.run()
+    assert sink.tuples == n_batches * per
+    assert src.shed_count() == 0
+
+
+def test_flusher_error_surfaces_instead_of_deadlocking_put():
+    # a dead flusher can never drain the stage: put() must surface the
+    # stored error rather than wait for space forever
+    from windflow_tpu.ingest.coalesce import ChunkCoalescer
+    from windflow_tpu.ingest.credits import CreditGate
+
+    class Boom:
+        def reduce(self, batch):
+            raise RuntimeError("pre-reduce exploded")
+
+    co = ChunkCoalescer(CreditGate(10_000), MicrobatchController(),
+                        stage_cap=600)
+    co.pre_reduce = Boom()
+    co.ensure_started(lambda item: None)
+    with pytest.raises(RuntimeError, match="pre-reduce exploded"):
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            co.put(make_trace(500, value=1.0))   # must raise, not hang
+    co.abort()
+
+
+def test_pane_prereducer_negative_ts_floor_division():
+    # negative timestamps must land in their containing pane (floor
+    # division) on both the native and the numpy path
+    n = 4096
+    ts = np.arange(n, dtype=np.int64) - n // 2
+    b = TupleBatch({"key": np.zeros(n, np.int64), "id": ts, "ts": ts,
+                    "value": np.ones(n)})
+    pr = PanePreReducer(256, "ts")
+    out = pr.reduce(b)
+    pr._native = False
+    ref = pr.reduce(b)
+    got = sorted((int(out.ts[i]), out["value"][i])
+                 for i in range(len(out)))
+    want = sorted((int(ref.ts[i]), ref["value"][i])
+                  for i in range(len(ref)))
+    assert got == want
+    assert min(t for t, _ in got) == -(n // 2)   # floored, not trunc'd
+
+
+def test_pane_prereducer_numpy_fallback_matches():
+    b = make_trace(30_000, n_keys=3, seed=2)
+    pr = PanePreReducer(512, "ts")
+    native_out = pr.reduce(b)
+    pr._native = False
+    ref = pr.reduce(b)
+    got = {(int(native_out.key[i]), int(native_out.ts[i])):
+           native_out["value"][i] for i in range(len(native_out))}
+    want = {(int(ref.key[i]), int(ref.ts[i])): ref["value"][i]
+            for i in range(len(ref))}
+    assert set(got) == set(want)
+    for k in want:
+        assert got[k] == pytest.approx(want[k], rel=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# async generator source
+# ---------------------------------------------------------------------------
+
+def test_async_generator_source():
+    async def gen():
+        for i in range(20):
+            yield make_trace(500, n_keys=2, seed=i, value=1.0)
+
+    src = wf.SourceBuilder.from_async(gen).build()
+    sink = BatchSink()
+    g = wf.PipeGraph("async_src", wf.Mode.DEFAULT)
+    g.add_source(src).add_sink(Sink(sink))
+    g.run()
+    assert sink.tuples == 20 * 500
+    assert sink.total == float(20 * 500)
+
+
+def test_async_generator_records():
+    async def gen():
+        for i in range(300):
+            yield (i % 3, i // 3, i // 3, 1.0)   # (key, id, ts, value)
+
+    src = wf.SourceBuilder.from_async(gen).build()
+    sink = BatchSink()
+    g = wf.PipeGraph("async_rec", wf.Mode.DEFAULT)
+    g.add_source(src).add_sink(Sink(sink))
+    g.run()
+    assert sink.tuples == 300
+    assert sink.total == 300.0

@@ -5,7 +5,7 @@ import numpy as np
 import pytest
 
 import windflow_tpu as wf
-from windflow_tpu.core import BasicRecord, Mode, WinType
+from windflow_tpu.core import Mode, WinType
 from windflow_tpu.core.tuples import TupleBatch
 from windflow_tpu.operators.batch_ops import BatchSource
 from windflow_tpu.operators.basic_ops import Sink

@@ -2,7 +2,6 @@
 import threading
 
 import numpy as np
-import pytest
 
 import windflow_tpu as wf
 from windflow_tpu.core import Mode

@@ -7,11 +7,10 @@ import socket
 import struct
 import threading
 
-import pytest
 
 import windflow_tpu as wf
 from windflow_tpu.core import BasicRecord, Mode, RuntimeConfig, WinType
-from windflow_tpu.monitoring.stats import GraphStats, StatsRecord
+from windflow_tpu.monitoring.stats import GraphStats
 
 
 class FakeDashboard(threading.Thread):

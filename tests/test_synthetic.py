@@ -1,0 +1,66 @@
+"""Synthetic stream fixtures (utils/synthetic.py).
+
+Regression coverage for the bounded-shuffle fixture: disorder must be
+jitter-bounded everywhere AND present in the stream tail (the old
+shuffle loop stopped `jitter` short of the end, so the tail was always
+in order and tail-sensitive paths went untested), and the generator
+must be reusable across runs instead of a single-use closure."""
+
+from windflow_tpu.core.shipper import Shipper
+from windflow_tpu.utils.synthetic import pareto_ooo_stream
+
+
+def _drain(fn):
+    out = []
+    while fn(Shipper(out.append), None):
+        pass
+    return out
+
+
+def test_pareto_ooo_disorder_is_jitter_bounded():
+    n_keys, per_key, jitter = 4, 9, 4
+    fn = pareto_ooo_stream(n_keys, per_key, seed=1, jitter=jitter)
+    events = fn.events
+    assert len(events) == n_keys * per_key
+    # pre-shuffle position of (k, i, ts) is i*n_keys + k (round-robin
+    # build order); the bounded shuffle may move it < jitter positions
+    for pos, (k, i, _ts) in enumerate(events):
+        assert abs(pos - (i * n_keys + k)) < jitter
+
+
+def test_pareto_ooo_tail_is_permuted():
+    n_keys, per_key, jitter = 4, 9, 4     # 36 events: tail window exact
+    permuted_tail = False
+    for seed in range(8):                 # at least one seed must shuffle
+        fn = pareto_ooo_stream(n_keys, per_key, seed=seed, jitter=jitter)
+        tail = fn.events[-jitter:]
+        in_order = [(i * n_keys + k) for k, i, _ in tail]
+        if in_order != sorted(in_order):
+            permuted_tail = True
+            break
+    assert permuted_tail, "stream tail is never out of order"
+
+
+def test_pareto_ooo_stream_is_restartable():
+    fn = pareto_ooo_stream(3, 5, seed=2, jitter=3)
+    first = [(r.key, r.id, r.ts) for r in _drain(fn)]
+    assert len(first) == 15
+    # exhaustion is sticky (parallel replicas share the closure, so an
+    # auto-rewind would duplicate the stream); reset() restarts it
+    assert _drain(fn) == []
+    fn.reset()
+    second = [(r.key, r.id, r.ts) for r in _drain(fn)]
+    assert second == first
+    fn(Shipper(lambda r: None), None)     # consume one event...
+    fn.reset()                            # ...then rewind mid-stream
+    third = [(r.key, r.id, r.ts) for r in _drain(fn)]
+    assert third == first
+
+
+def test_pareto_ooo_timestamps_advance_per_key():
+    fn = pareto_ooo_stream(3, 20, seed=4, jitter=3)
+    per_key = {}
+    for k, i, ts in sorted(fn.events, key=lambda e: (e[0], e[1])):
+        if k in per_key:
+            assert ts > per_key[k]        # strictly increasing per key
+        per_key[k] = ts

@@ -43,6 +43,17 @@ def __getattr__(name):
         "InjectedFailure": "windflow_tpu.resilience",
         "DeadLetterStore": "windflow_tpu.resilience",
         "DeadLetterEntry": "windflow_tpu.resilience",
+        # adaptive ingestion plane (ingest/; docs/INGEST.md)
+        "SocketSource": "windflow_tpu.ingest",
+        "ReplaySource": "windflow_tpu.ingest",
+        "AsyncGeneratorSource": "windflow_tpu.ingest",
+        "CreditGate": "windflow_tpu.ingest",
+        "MicrobatchController": "windflow_tpu.ingest",
+        "AdmissionConfig": "windflow_tpu.ingest",
+        "ShedTuples": "windflow_tpu.ingest",
+        "encode_batch": "windflow_tpu.ingest",
+        "decode_batch": "windflow_tpu.ingest",
+        "StreamDecoder": "windflow_tpu.ingest",
         # mesh-scale operators + mesh construction (multi-chip plane)
         "KeyFarmMesh": "windflow_tpu.operators.tpu.mesh_farm",
         "PaneFarmMesh": "windflow_tpu.operators.tpu.pane_mesh",

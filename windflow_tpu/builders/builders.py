@@ -142,7 +142,90 @@ class _WinBuilderBase(_BuilderBase):
 
 @_alias_camel
 class SourceBuilder(_BuilderBase):
+    """Builds the classic shipper-style :class:`Source` from a callable,
+    or -- via the ``from_socket`` / ``from_replay`` / ``from_async``
+    constructors -- an ingest-plane source (docs/INGEST.md) with
+    credit-based backpressure, an adaptive microbatch controller and
+    optional admission control."""
+
     _default_name = "source"
+
+    def __init__(self, fn=None):
+        super().__init__(fn)
+        self._ingest_kind = None
+        self._ingest_args: dict = {}
+        self.credits = None           # None = RuntimeConfig.ingest_credits
+        self.admission = None
+        self.latency_target_ms = None
+        self.initial_batch = None
+
+    # -- ingest-plane constructors (windflow_tpu/ingest/) ---------------
+    @classmethod
+    def from_socket(cls, host: str, port: int,
+                    connect_timeout_s: float = 10.0) -> "SourceBuilder":
+        """Non-blocking framed-TCP source (ingest.codec protocol); each
+        replica opens one client connection."""
+        b = cls(None)
+        b._ingest_kind = "socket"
+        b._ingest_args = dict(host=host, port=port,
+                              connect_timeout_s=connect_timeout_s)
+        b.name = "socket_source"
+        return b
+
+    @classmethod
+    def from_replay(cls, trace, speedup: Optional[float] = 1.0,
+                    ts_unit_s: float = 1e-6, chunk: Optional[int] = 65536,
+                    seed: int = 0) -> "SourceBuilder":
+        """Timestamp-faithful replay of a recorded trace (TupleBatch,
+        dict of columns, or .npz path) at ``speedup`` x real time
+        (None = as fast as possible); deterministic under ``seed``."""
+        b = cls(None)
+        b._ingest_kind = "replay"
+        b._ingest_args = dict(trace=trace, speedup=speedup,
+                              ts_unit_s=ts_unit_s, chunk=chunk, seed=seed)
+        b.name = "replay"
+        return b
+
+    @classmethod
+    def from_async(cls, factory) -> "SourceBuilder":
+        """Async-generator source: ``factory()`` is called per replica
+        and must return an async generator yielding TupleBatch items or
+        records."""
+        b = cls(None)
+        b._ingest_kind = "async"
+        b._ingest_args = dict(factory=factory)
+        b.name = "async_source"
+        return b
+
+    # -- ingest-plane knobs ---------------------------------------------
+    def with_credits(self, budget: int) -> "SourceBuilder":
+        """Per-replica credit budget: tuples outstanding in outlet
+        channels before the transport stops reading."""
+        self.credits = budget
+        return self
+
+    def with_admission(self, policy: str, max_wait_ms: float = 0.0,
+                       seed: int = 0) -> "SourceBuilder":
+        """Overload policy ('drop_newest' | 'drop_oldest' | 'sample'):
+        shed instead of blocking once an arrival has waited
+        ``max_wait_ms`` for stage space; shed tuples are quarantined in
+        ``graph.dead_letters`` (docs/INGEST.md)."""
+        from ..ingest.admission import AdmissionConfig
+        self.admission = AdmissionConfig(policy, max_wait_ms, seed)
+        return self
+
+    def with_latency_target(self, target_ms: float) -> "SourceBuilder":
+        """Per-source latency budget override for the microbatch
+        controller (defaults to RuntimeConfig.latency_target_ms)."""
+        self.latency_target_ms = target_ms
+        return self
+
+    def with_microbatch(self, initial_batch: int) -> "SourceBuilder":
+        """Initial coalesced batch size; the AIMD controller adapts
+        from here (this replaces the static RuntimeConfig.microbatch
+        knob for ingest-fed runs)."""
+        self.initial_batch = initial_batch
+        return self
 
     def with_error_policy(self, policy: str):
         """Sources reject non-default policies loudly: a generation
@@ -155,9 +238,26 @@ class SourceBuilder(_BuilderBase):
                 "per-tuple svc processing (docs/RESILIENCE.md)")
         return self
 
-    def build(self) -> Source:
-        return Source(self.fn, self.parallelism, self.name,
-                      self.closing_func)
+    def build(self):
+        if self._ingest_kind is None:
+            if self.fn is None:
+                raise ValueError(
+                    "SourceBuilder needs a generation function, or use "
+                    "from_socket/from_replay/from_async (docs/INGEST.md)")
+            return Source(self.fn, self.parallelism, self.name,
+                          self.closing_func)
+        from ..ingest.sources import (AsyncGeneratorSource, ReplaySource,
+                                      SocketSource)
+        kw = dict(parallelism=self.parallelism, name=self.name,
+                  credits=self.credits, admission=self.admission,
+                  latency_target_ms=self.latency_target_ms,
+                  initial_batch=self.initial_batch,
+                  closing_func=self.closing_func)
+        if self._ingest_kind == "socket":
+            return SocketSource(**self._ingest_args, **kw)
+        if self._ingest_kind == "replay":
+            return ReplaySource(**self._ingest_args, **kw)
+        return AsyncGeneratorSource(**self._ingest_args, **kw)
 
 
 @_alias_camel

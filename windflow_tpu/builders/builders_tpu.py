@@ -12,14 +12,13 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
-from ..core.basic import OptLevel, WinType
 from ..operators.tpu.farms_tpu import (KeyFarmTPU, KeyFFATTPU, PaneFarmTPU,
                                        WinFarmTPU, WinMapReduceTPU,
                                        WinSeqFFATTPU)
 from ..operators.tpu.win_seq_tpu import (DEFAULT_BATCH_LEN,
     DEFAULT_INFLIGHT_DEPTH, DEFAULT_MAX_BATCH_DELAY_MS,
     DEFAULT_MAX_BUFFER_ELEMS, WinSeqTPU)
-from .builders import _BuilderBase, _WinBuilderBase, _alias_camel
+from .builders import _WinBuilderBase, _alias_camel
 
 
 class _TPUBuilderMixin:

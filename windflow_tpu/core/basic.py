@@ -179,3 +179,13 @@ class RuntimeConfig:
     cancel_grace_s: float = 5.0
     # resilience.faults.FaultPlan bound to the graph at start() (tests)
     fault_plan: Any = None
+    # -- ingestion plane (ingest/; docs/INGEST.md) ----------------------
+    # end-to-end latency budget for ingest-fed runs: the adaptive
+    # microbatch controller AIMDs coalesced batch size / flush interval
+    # against it and rewrites directly-fed device engines' launch
+    # delay, replacing the static microbatch knobs (None = keep the
+    # static operating point)
+    latency_target_ms: Optional[float] = None
+    # default per-source-replica credit budget (tuples outstanding in
+    # outlet channels before the transport stops reading)
+    ingest_credits: int = 1 << 16

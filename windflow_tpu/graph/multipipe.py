@@ -14,8 +14,8 @@ from typing import Any, Callable, List, Optional
 
 from ..core.basic import Mode, OrderingMode, RoutingMode, WinType
 from ..operators.base import Operator, StageSpec
-from ..runtime.emitters import SplittingEmitter, StandardEmitter
-from ..runtime.node import NodeLogic, Outlet, RtNode
+from ..runtime.emitters import StandardEmitter
+from ..runtime.node import Outlet, RtNode
 from ..runtime.ordering import KSlackLogic, OrderingLogic
 from ..runtime.queues import Channel, make_channel
 

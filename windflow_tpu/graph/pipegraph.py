@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import threading
 import time as _time
-from typing import Any, Callable, List, Optional, Sequence
+from typing import List, Optional, Sequence
 
 from ..core.basic import Mode, RuntimeConfig
 from ..operators.base import Operator
@@ -199,6 +199,13 @@ class PipeGraph:
         # store / any bound fault-injection state
         from ..runtime.node import SourceLoopLogic, SourcePauseControl
         self._pause_ctl = SourcePauseControl()
+        # ingest plane (ingest/wiring.py): wrap ingest outlet channels
+        # in credit proxies, register gates/stages with the CancelToken
+        # and bind the microbatch controller to downstream engines --
+        # BEFORE the channel loop below so consumers register their
+        # (proxied) channels with the token
+        from ..ingest.wiring import wire_ingest
+        wire_ingest(self)
         fault_plan = getattr(self.config, "fault_plan", None)
         for n in self._all_nodes():
             n.pause_ctl = self._pause_ctl

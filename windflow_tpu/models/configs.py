@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import threading
 
-import numpy as np
 
 
 class ResultCollector:

@@ -17,7 +17,6 @@ the same application twice:
 from __future__ import annotations
 
 import functools
-from typing import Callable, Tuple
 
 import numpy as np
 

@@ -21,7 +21,6 @@ from __future__ import annotations
 import socket
 import struct
 import threading
-import time
 
 
 def graph_to_dot(graph) -> str:

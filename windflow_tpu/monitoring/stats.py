@@ -11,11 +11,10 @@ bytes staged to device, stats_record.hpp:77-79).
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 
 @dataclass
@@ -42,6 +41,15 @@ class StatsRecord:
     num_launches: int = 0
     bytes_to_device: int = 0
     bytes_from_device: int = 0
+    # ingest-plane metrics (ingest/; zero outside ingest sources):
+    # admission-shed tuples, live credit level, tuples parked in outlet
+    # channels, the controller's current coalesced batch size and its
+    # recent (time, batch_size) decision trace
+    tuples_shed: int = 0
+    credits_available: int = 0
+    ingest_queue_depth: int = 0
+    ingest_batch_size: int = 0
+    controller_trace: list = field(default_factory=list)
 
     def observe(self, elapsed_us: float) -> None:
         n = max(1, self.inputs_received)
@@ -51,7 +59,7 @@ class StatsRecord:
         self.terminated = True
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "Replica_id": self.replica_id,
             "Starting_time": self.start_time,
             "Terminated": self.terminated,
@@ -61,12 +69,20 @@ class StatsRecord:
             "Bytes_sent": self.bytes_sent,
             "Inputs_ignored": self.inputs_ignored,
             "Svc_failures": self.svc_failures,
+            "Shed_tuples": self.tuples_shed,
             "Service_time_usec": round(self.service_time_us, 3),
             "Eff_Service_time_usec": round(self.eff_service_time_us, 3),
             "Device_launches": self.num_launches,
             "Bytes_to_device": self.bytes_to_device,
             "Bytes_from_device": self.bytes_from_device,
         }
+        if self.ingest_batch_size:     # ingest source replicas only
+            d["Ingest_credits"] = self.credits_available
+            d["Ingest_queue_depth"] = self.ingest_queue_depth
+            d["Ingest_batch_size"] = self.ingest_batch_size
+            d["Controller_batch_trace"] = [
+                [round(t, 3), b] for t, b in self.controller_trace[-32:]]
+        return d
 
 
 def get_mem_usage_kb() -> int:
@@ -110,6 +126,8 @@ class GraphStats:
             ]
             svc_failures = sum(r.svc_failures
                                for rs in self.records.values() for r in rs)
+            shed_tuples = sum(r.tuples_shed
+                              for rs in self.records.values() for r in rs)
         return json.dumps({
             "PipeGraph_name": self.graph_name,
             "Mode": "DEFAULT",
@@ -120,6 +138,9 @@ class GraphStats:
             # of those were quarantined in the dead-letter store
             "Svc_failures": svc_failures,
             "Dead_letter_tuples": dead_letter_tuples,
+            # ingest admission control (ingest/admission.py): tuples
+            # shed under overload (also quarantined above)
+            "Shed_tuples": shed_tuples,
             "Memory_usage_KB": get_mem_usage_kb(),
             "Operator_number": len(ops),
             "Operators": ops,

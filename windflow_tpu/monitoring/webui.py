@@ -205,11 +205,18 @@ function opRow(op) {
   const sum = k => rs.reduce((a, r) => a + num(r[k]), 0);
   const svc = rs.length ?
     rs.reduce((a, r) => a + num(r.Service_time_usec), 0) / rs.length : 0;
+  // ingest replicas report credits / queue depth / controller batch
+  // size; other operators render a dash
+  const ing = rs.some(r => "Ingest_batch_size" in r) ?
+    `${fmt(sum("Ingest_credits"))}cr q${fmt(sum("Ingest_queue_depth"))} ` +
+    `b${fmt(sum("Ingest_batch_size"))}` : "–";
   return `<tr><td>${esc(op.Operator_name)}</td><td>${num(op.Parallelism)}</td>
     <td>${fmt(sum("Inputs_received"))}</td>
     <td>${fmt(sum("Outputs_sent"))}</td>
     <td>${fmt(sum("Inputs_ignored"))}</td>
     <td>${fmt(sum("Svc_failures"))}</td>
+    <td>${fmt(sum("Shed_tuples"))}</td>
+    <td>${ing}</td>
     <td>${svc.toFixed(1)}</td>
     <td>${fmt(sum("Device_launches"))}</td>
     <td>${fmt(sum("Bytes_to_device"))}</td>
@@ -247,6 +254,9 @@ function render(apps) {
           ${fmt(rep.Svc_failures || 0)}</div>
           <div class="k">svc failures
           (${fmt(rep.Dead_letter_tuples || 0)} dead-lettered)</div></div>
+        <div class="tile"><div class="v${num(rep.Shed_tuples) ? " bad" : ""}">
+          ${fmt(rep.Shed_tuples || 0)}</div>
+          <div class="k">shed tuples (admission)</div></div>
         <div class="tile"><div class="v">${replicas}</div>
           <div class="k">replicas (${num(rep.Operator_number)} ops)</div></div>
         <div class="tile"><div class="v">
@@ -256,7 +266,8 @@ function render(apps) {
       ${a.diagram.trim().startsWith("<svg") ? svgImg(a.diagram) : topoSvg(parseDot(a.diagram))}
       <div class="spark-wrap">${sparkline(id, hist[id])}</div>
       <table><thead><tr><th>operator</th><th>par</th><th>in</th>
-        <th>out</th><th>ignored</th><th>fails</th><th>svc &micro;s</th>
+        <th>out</th><th>ignored</th><th>fails</th><th>shed</th>
+        <th>ingest</th><th>svc &micro;s</th>
         <th>launches</th><th>B&rarr;dev</th><th>B&larr;dev</th></tr>
       </thead><tbody>${ops.map(opRow).join("")}</tbody></table>
     </div>`;

@@ -11,7 +11,7 @@ nesting (multipipe.hpp:236-341).
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Optional
 
 from ..core.basic import OrderingMode, Pattern, RoutingMode

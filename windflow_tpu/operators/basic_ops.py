@@ -20,7 +20,6 @@ Python signature conventions (replacing the C++ overload sets, API:11-43):
 from __future__ import annotations
 
 import copy
-from typing import Any, Callable, List, Optional
 
 from ..core.basic import Pattern, RoutingMode, OrderingMode
 from ..core.context import RuntimeContext

@@ -13,12 +13,10 @@ both planes share queues, emitters, windows and graphs.
 """
 from __future__ import annotations
 
-from typing import Callable, Optional
 
 from ..core.basic import OrderingMode, Pattern, RoutingMode
 from ..core.context import RuntimeContext
 from ..core.meta import with_context
-from ..core.tuples import EOS, TupleBatch
 from ..runtime.emitters import StandardEmitter
 from ..runtime.node import EOSMarker, NodeLogic, SourceLoopLogic
 from .base import Operator, StageSpec

@@ -27,9 +27,8 @@ from __future__ import annotations
 
 from typing import List, Union
 
-from ..core.basic import (OptLevel, OrderingMode, Pattern, Role, RoutingMode,
-                          WinOperatorConfig, WinType)
-from ..runtime.emitters import Emitter, StandardEmitter, TreeEmitter
+from ..core.basic import (OptLevel, Pattern, Role, RoutingMode, WinOperatorConfig)
+from ..runtime.emitters import StandardEmitter, TreeEmitter
 from ..runtime.win_routing import KFEmitter, WFEmitter, WidOrderCollector
 from .base import Operator, StageSpec
 from .pane_farm import PaneFarm

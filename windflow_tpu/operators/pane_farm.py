@@ -19,10 +19,9 @@ from ..core.tuples import BasicRecord
 from ..core.win_assign import pane_length
 from .base import Operator
 from .win_farm import WinFarm
-from .win_seq import WinSeq, WinSeqLogic
+from .win_seq import WinSeqLogic
 from ..core.basic import OrderingMode
 from ..runtime.emitters import StandardEmitter
-from ..runtime.win_routing import WidOrderCollector
 from .base import StageSpec
 
 

@@ -16,11 +16,10 @@ on the scalar plane, identical content either way.
 """
 from __future__ import annotations
 
-import numpy as np
 
 from ..core.basic import Pattern, RoutingMode
 from ..core.context import RuntimeContext
-from ..core.tuples import BasicRecord, SynthChunk, TupleBatch
+from ..core.tuples import BasicRecord, SynthChunk
 from ..runtime.emitters import StandardEmitter
 from ..runtime.node import SourceLoopLogic
 from .base import Operator, StageSpec

@@ -22,7 +22,7 @@ pair with the combine either builtin or a JAX binary function.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable
 
 from ...core.basic import (OptLevel, OrderingMode, Pattern, Role,
                            RoutingMode, WinOperatorConfig, WinType)

@@ -26,8 +26,7 @@ from typing import Any, Callable, Dict, List
 
 import numpy as np
 
-from ...core.basic import (OrderingMode, Pattern, Role, RoutingMode,
-                           WinType)
+from ...core.basic import OrderingMode, Pattern, RoutingMode, WinType
 from ...core.tuples import BasicRecord, TupleBatch
 from ...runtime.emitters import StandardEmitter
 from ...runtime.node import EOSMarker, NodeLogic

@@ -16,7 +16,7 @@ key_farm_gpu.hpp.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 import numpy as np
 

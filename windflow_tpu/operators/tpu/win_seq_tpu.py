@@ -31,7 +31,7 @@ from ...core.basic import (OrderingMode, Pattern, Role, RoutingMode,
 from ...core.meta import default_hash
 from ...core.tuples import BasicRecord, SynthChunk, TupleBatch
 from ...core import win_assign as wa
-from ...ops.window_compute import DeviceBatchHandle, WindowComputeEngine
+from ...ops.window_compute import WindowComputeEngine
 from ...runtime.emitters import StandardEmitter
 from ...runtime.node import EOSMarker, NodeLogic
 from ..base import Operator, StageSpec

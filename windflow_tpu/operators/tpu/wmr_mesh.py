@@ -20,7 +20,6 @@ shards.  The reference cannot express either beyond one process
 """
 from __future__ import annotations
 
-from typing import Dict
 
 import numpy as np
 

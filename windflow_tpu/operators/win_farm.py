@@ -10,7 +10,7 @@ workers' outer level (configSeq construction, win_farm.hpp:175).
 """
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Callable
 
 from ..core.basic import (OptLevel, OrderingMode, Pattern, Role, RoutingMode,
                           WinOperatorConfig, WinType)

@@ -13,14 +13,14 @@ Two query styles (API:44-100):
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List
 
 from ..core.archive import StreamArchive
 from ..core.basic import (OrderingMode, Pattern, Role, RoutingMode,
                           WinOperatorConfig, WinType, WinEvent)
 from ..core.context import RuntimeContext
 from ..core.iterable import Iterable
-from ..core.meta import default_hash, is_rich, with_context
+from ..core.meta import default_hash, with_context
 from ..core.tuples import BasicRecord
 from ..core.window import TriggererCB, TriggererTB, Window
 from ..core import win_assign as wa

@@ -14,7 +14,7 @@ from __future__ import annotations
 import bisect
 from typing import Any, Callable, Dict, List
 
-from ..core.basic import (OrderingMode, Pattern, Role, RoutingMode, WinType)
+from ..core.basic import OrderingMode, Pattern, RoutingMode, WinType
 from ..core.context import RuntimeContext
 from ..core.flatfat import FlatFAT
 from ..core.meta import with_context

@@ -21,7 +21,7 @@ of the reference).
 from __future__ import annotations
 
 import functools
-from typing import Callable, Tuple
+from typing import Callable
 
 import numpy as np
 

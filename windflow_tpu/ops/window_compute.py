@@ -32,7 +32,7 @@ from __future__ import annotations
 import functools
 import os
 import threading
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict
 
 import numpy as np
 

@@ -14,7 +14,7 @@ ICI/DCN.  Axis conventions used throughout:
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Tuple
 
 
 def make_mesh(n_devices: Optional[int] = None,

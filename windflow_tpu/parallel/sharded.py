@@ -24,7 +24,7 @@ replica per shard keeps the batching protocol unchanged).
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Dict
 
 import numpy as np
 

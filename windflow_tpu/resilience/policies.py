@@ -68,7 +68,12 @@ class DeadLetterStore:
         self._by_node: Dict[str, int] = {}
         self.max_entries = max_entries
 
-    def add(self, node: str, item: Any, error: BaseException) -> None:
+    def add(self, node: str, item: Any, error: BaseException,
+            count: int = 1) -> None:
+        """Quarantine one entry advancing the counters by ``count``
+        tuples.  Bulk callers (ingest admission shedding) pass the shed
+        total with a sample batch as ``item`` -- a columnar overload
+        must not cost one store entry per tuple."""
         # format the traceback OF THE GIVEN ERROR, not whatever
         # exception happens to be ambient (format_exc would record
         # "NoneType: None" when called outside an except block)
@@ -76,8 +81,8 @@ class DeadLetterStore:
             type(error), error, error.__traceback__))
         entry = DeadLetterEntry(node, item, error, tb)
         with self._lock:
-            self._count += 1
-            self._by_node[node] = self._by_node.get(node, 0) + 1
+            self._count += count
+            self._by_node[node] = self._by_node.get(node, 0) + count
             if len(self._entries) < self.max_entries:
                 self._entries.append(entry)
 

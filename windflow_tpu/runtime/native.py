@@ -16,7 +16,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Any, Optional, Tuple
+from typing import Any, Optional
 
 from .queues import CHANNEL_TIMEOUT
 
@@ -114,6 +114,17 @@ def get_lib():
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
             ctypes.c_longlong, ctypes.POINTER(ctypes.c_int),
             ctypes.POINTER(ctypes.c_longlong)]
+        _PLL = ctypes.POINTER(ctypes.c_longlong)
+        lib.wfn_pane_prereduce.restype = ctypes.c_longlong
+        lib.wfn_pane_prereduce.argtypes = [
+            _PLL, _PLL, ctypes.POINTER(ctypes.c_double),
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+            _PLL, _PLL, ctypes.POINTER(ctypes.c_double)]
+        lib.wfn_pane_prereduce_f32.restype = ctypes.c_longlong
+        lib.wfn_pane_prereduce_f32.argtypes = [
+            _PLL, _PLL, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+            _PLL, _PLL, ctypes.POINTER(ctypes.c_double)]
         LL = ctypes.c_longlong
         PLL = ctypes.POINTER(LL)
         PD = ctypes.POINTER(ctypes.c_double)
@@ -260,6 +271,46 @@ class NativeChannel:
                 lib.wfn_channel_free(ptr)
         except (TypeError, AttributeError):
             pass  # interpreter shutdown: ctypes globals already torn down
+
+
+def pane_prereduce(keys, tss, values, pane: int):
+    """Fused ingest-plane pane pre-reduction (ingest/coalesce.py):
+    collapse a columnar chunk to per-(key, pane) sum partials in one
+    native pass.  Returns (keys, pane_starts, sums) arrays or None when
+    the library is unavailable / the domain is too sparse for the
+    dense-grid kernel (callers fall back to numpy or pass-through)."""
+    import numpy as np
+    lib = get_lib()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, np.int64)
+    tss = np.ascontiguousarray(tss, np.int64)
+    if values.dtype == np.float32:
+        values = np.ascontiguousarray(values)
+        fn = lib.wfn_pane_prereduce_f32
+        vp = values.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    else:
+        values = np.ascontiguousarray(values, np.float64)
+        fn = lib.wfn_pane_prereduce
+        vp = values.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+    n = len(keys)
+    cap = min(n, 1 << 16)
+    while True:
+        out_k = np.empty(cap, np.int64)
+        out_p = np.empty(cap, np.int64)
+        out_s = np.empty(cap, np.float64)
+        m = fn(keys.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+               tss.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+               vp, n, pane, cap,
+               out_k.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+               out_p.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+               out_s.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        if m == -1:
+            return None  # sparse domain: dense grid refused
+        if m == -2:
+            cap = n      # partials cannot outnumber tuples
+            continue
+        return out_k[:m], out_p[:m], out_s[:m]
 
 
 def pane_reduce(values, pos, kind: str):

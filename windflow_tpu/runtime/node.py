@@ -13,7 +13,7 @@ from __future__ import annotations
 import threading
 import time as _time
 import traceback
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 from ..core.tuples import SynthChunk
 from ..resilience.cancel import GraphCancelled

@@ -21,7 +21,7 @@ import threading
 import time as _time
 import warnings
 from collections import deque
-from typing import Any, List, Optional, Tuple
+from typing import Any, Optional
 
 from ..core.basic import DEFAULT_QUEUE_CAPACITY
 from ..resilience.cancel import GraphCancelled

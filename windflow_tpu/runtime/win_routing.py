@@ -9,7 +9,7 @@ the routing level: window multicast (Win_Farm), key partitioning
 from __future__ import annotations
 
 import heapq
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List
 
 from ..core.basic import Role, WinType
 from ..core.meta import default_hash

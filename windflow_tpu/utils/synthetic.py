@@ -8,7 +8,7 @@ triggering delays and for the PROBABILISTIC (K-slack) mode.
 from __future__ import annotations
 
 import random
-from typing import Any, Callable, Iterator, Optional
+from typing import Any, Callable
 
 import numpy as np
 
@@ -55,8 +55,12 @@ def pareto_ooo_stream(n_keys: int, per_key: int, seed: int = 0,
         for k in range(n_keys):
             ts[k] += max(1, int(rnd.paretovariate(alpha)))
             buffer.append((k, i, ts[k]))
-    # bounded shuffle: swap within windows of `jitter`
-    for i in range(0, len(buffer) - jitter, jitter):
+    # bounded shuffle: permute within consecutive windows of `jitter`,
+    # INCLUDING the final partial window -- the old loop stopped at
+    # len(buffer) - jitter, so the stream tail was always in order and
+    # tail-sensitive paths (EOS flush of open windows, K-slack late
+    # handling at stream end) were never exercised out of order
+    for i in range(0, len(buffer), jitter):
         window = buffer[i:i + jitter]
         rnd.shuffle(window)
         buffer[i:i + jitter] = window
@@ -65,6 +69,10 @@ def pareto_ooo_stream(n_keys: int, per_key: int, seed: int = 0,
     def fn(shipper, ctx):
         i = state["i"]
         if i >= len(buffer):
+            # exhausted state stays sticky: parallel replicas share this
+            # closure, and an auto-rewind here would hand the whole
+            # buffer to a replica still in its step loop.  reset() below
+            # is the explicit restart.
             return False
         k, tid, t = buffer[i]
         key: Any = f"key_{k}" if key_type == "str" else k
@@ -72,7 +80,11 @@ def pareto_ooo_stream(n_keys: int, per_key: int, seed: int = 0,
         state["i"] = i + 1
         return True
 
+    def reset():
+        state["i"] = 0
+
     fn.events = list(buffer)
+    fn.reset = reset
     return fn
 
 
