@@ -208,7 +208,7 @@ def _chunk_source(n_events, sb=SOURCE_BATCH, stamps=None):
 
 
 def run_win_seq_tpu(n_events, source_batch=None, delay_ms=10.0,
-                    chunked=True):
+                    chunked=True, opt_level=None):
     """Config #2: declared synthetic source -> WinSeqTPU -> sink.
 
     ``chunked=True`` (the headline): the source ships SynthChunk
@@ -234,7 +234,9 @@ def run_win_seq_tpu(n_events, source_batch=None, delay_ms=10.0,
     else:
         src = _template_source(n_events, {}, sb)
         sink = _WindowLatencySink([], sb)  # rate/windows only
-    g = wf.PipeGraph("bench2", wf.Mode.DEFAULT)
+    cfg = (wf.RuntimeConfig() if opt_level is None
+           else wf.RuntimeConfig(opt_level=opt_level))
+    g = wf.PipeGraph("bench2", wf.Mode.DEFAULT, config=cfg)
     op = WinSeqTPU("sum", WIN, SLIDE, wf.WinType.TB,
                    batch_len=DEVICE_BATCH, emit_batches=True,
                    max_buffer_elems=MAX_BUFFER, inflight_depth=INFLIGHT,
@@ -286,14 +288,17 @@ class _IngestLatencySink:
             self.lats.extend((now - ts[idx]).tolist())
 
 
-def run_ingest_feed(n_events, latency_target_ms=50.0):
+def run_ingest_feed(n_events, latency_target_ms=50.0, opt_level=None):
     """Config #2g: replay-trace feed through the adaptive ingest plane
     (ingest/: credit-gated replay source, AIMD microbatch controller,
     native pane pre-reduction) into the same WinSeqTPU engine as #2f.
     The trace is materialized up front -- the source replays recorded
     columns, the operating point external feeds pay once the ingest
-    plane, not per-tuple Python, owns admission."""
+    plane, not per-tuple Python, owns admission.  #2h is the same
+    pipeline at OptLevel.LEVEL2 (graph/fuse.py: the engine fuses with
+    the sink; the ingest source keeps its credit boundary)."""
     import windflow_tpu as wf
+    from windflow_tpu.core.basic import OptLevel
     from windflow_tpu.core.tuples import TupleBatch
     from windflow_tpu.operators.basic_ops import Sink
     from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
@@ -306,7 +311,9 @@ def run_ingest_feed(n_events, latency_target_ms=50.0):
             np.float32)})
     src = wf.SourceBuilder.from_replay(trace, speedup=None, chunk=None) \
         .with_microbatch(1 << 19).with_credits(1 << 21).build()
-    cfg = wf.RuntimeConfig(latency_target_ms=latency_target_ms)
+    cfg = wf.RuntimeConfig(latency_target_ms=latency_target_ms,
+                           opt_level=(OptLevel.LEVEL2 if opt_level is None
+                                      else opt_level))
     g = wf.PipeGraph("bench2g", wf.Mode.DEFAULT, config=cfg)
     op = WinSeqTPU("sum", WIN, SLIDE, wf.WinType.TB,
                    batch_len=DEVICE_BATCH, emit_batches=True,
@@ -418,17 +425,20 @@ def run_yahoo(n_events):
     return n_events / dt, sink.windows
 
 
-def run_nexmark(query, n_bids):
+def run_nexmark(query, n_bids, opt_level=None):
     """Config #6: NEXMark-style queries, the second application family
     (models/nexmark.py).  Q5 = per-auction sliding-window bid counts
     (KeyFarmTPU 'count'); Q7 = global per-window highest bid
-    (WinSeqTPU 'max' after the Q1 currency map)."""
+    (WinSeqTPU 'max' after the Q1 currency map).  ``opt_level`` pins
+    the graph compile pass for the fused-vs-unfused delta report."""
     import windflow_tpu as wf
     from windflow_tpu.models.nexmark import (build_q5_hot_items,
                                              build_q7_highest_bid)
 
     sink = _CountSink()
-    g = wf.PipeGraph(f"bench6_{query}", wf.Mode.DEFAULT)
+    cfg = (wf.RuntimeConfig() if opt_level is None
+           else wf.RuntimeConfig(opt_level=opt_level))
+    g = wf.PipeGraph(f"bench6_{query}", wf.Mode.DEFAULT, config=cfg)
     nex_batch = 4 * DEVICE_BATCH  # fewer, larger launches: the bid
     #                                 stream fires many small windows
     if query == "q5":
@@ -445,6 +455,45 @@ def run_nexmark(query, n_bids):
     g.run()
     dt = time.perf_counter() - t0
     return n_bids / dt, sink.windows
+
+
+def run_record_chain_host(n_records, opt_level=None):
+    """Config #7: the host RECORD plane under Python (non-Expr)
+    callables -- the chain cannot lower natively, so every record used
+    to pay one condition-variable round trip per channel hop.  This is
+    the direct measurement of the graph compile pass (docs/RUNTIME.md):
+    at LEVEL2 the whole chain runs in one replica thread and the hops
+    vanish."""
+    import windflow_tpu as wf
+
+    state = {"i": 0}
+
+    def src(shipper):
+        i = state["i"]
+        if i >= n_records:
+            return False
+        shipper.push(wf.BasicRecord(i % 16, i // 16, i // 16,
+                                    float(i % 97)))
+        state["i"] = i + 1
+        return True
+
+    count = {"n": 0}
+
+    def sink(r):
+        if r is not None:
+            count["n"] += 1
+
+    cfg = (wf.RuntimeConfig() if opt_level is None
+           else wf.RuntimeConfig(opt_level=opt_level))
+    g = wf.PipeGraph("bench7", wf.Mode.DEFAULT, config=cfg)
+    g.add_source(wf.SourceBuilder(src).build()) \
+        .add(wf.MapBuilder(lambda t: wf.BasicRecord(
+            t.key, t.id, t.ts, t.value * 1.0001)).build()) \
+        .add(wf.FilterBuilder(lambda t: t.value >= 0.0).build()) \
+        .add_sink(wf.SinkBuilder(sink).build())
+    t0 = time.perf_counter()
+    g.run()
+    return n_records / (time.perf_counter() - t0), count["n"]
 
 
 def run_reference_arch_baseline(n_events):
@@ -572,8 +621,12 @@ def main():
         "vs_baseline": _vs(rate2f)}
     # ingest-plane feed: the same engine driven through the adaptive
     # ingestion plane (replay source + credits + AIMD controller + pane
-    # pre-reduction) -- tracks the ingest plane's gap to the fused lane
-    rate2g, w2g, shed2g, lat_g, ing_m = run_ingest_feed(16_000_000)
+    # pre-reduction) -- tracks the ingest plane's gap to the fused lane.
+    # Pinned to LEVEL0 so the 2g operating point stays comparable
+    # across the LEVEL2-default change; 2h below is the fused twin.
+    from windflow_tpu.core.basic import OptLevel
+    rate2g, w2g, shed2g, lat_g, ing_m = run_ingest_feed(
+        16_000_000, opt_level=OptLevel.LEVEL0)
     p50g, p99g = _pcts(lat_g)
     configs["2g_ingest_feed"] = {
         "rate": round(rate2g, 1), "windows": w2g,
@@ -583,6 +636,17 @@ def main():
         "vs_feed": round(rate2g / rate2f, 2),
         "controller_batch_final": ing_m["batch_size"],
         "credit_waits": ing_m["credit_waits"]}
+    # ingest feed + LEVEL2 (graph/fuse.py): engine+sink fused, credit
+    # boundary intact -- the compile pass's delta on the ingest path
+    rate2h, w2h, shed2h, lat_h, _ing_h = run_ingest_feed(
+        16_000_000, opt_level=OptLevel.LEVEL2)
+    p50h, p99h = _pcts(lat_h)
+    configs["2h_win_seq_tpu_feed_fused"] = {
+        "rate": round(rate2h, 1), "windows": w2h,
+        "shed_tuples": shed2h,
+        "window_latency_p50_ms": p50h, "window_latency_p99_ms": p99h,
+        "vs_baseline": _vs(rate2h),
+        "fused_delta": round(rate2h / rate2g, 2)}
     # configs 3/4 run the same workload as the baseline, so they carry
     # vs_baseline too; 5/6 are different workloads (no ratio)
     rate3, w3 = run_pane_farm_tpu(32_000_000)
@@ -593,12 +657,31 @@ def main():
                                  "vs_baseline": _vs(rate4)}
     rate5, w5 = run_yahoo(16_000_000)
     configs["5_yahoo_wmr"] = {"rate": round(rate5, 1), "windows": w5}
+    # NexMark at both fusion levels: fused_delta = LEVEL2 / LEVEL0
+    # (the compile pass's win on the per-hop-heavy query pipelines).
+    # Per-query warmup first: each query's engine kind XLA-compiles on
+    # first launch, and that compile must not land in either timed run
     for q in ("q5", "q7"):
-        rq, wq = run_nexmark(q, 16_000_000)
-        configs[f"6_nexmark_{q}"] = {"rate": round(rq, 1), "windows": wq}
+        run_nexmark(q, 2_000_000)
+        rq0, _wq0 = run_nexmark(q, 16_000_000, opt_level=OptLevel.LEVEL0)
+        rq, wq = run_nexmark(q, 16_000_000, opt_level=OptLevel.LEVEL2)
+        configs[f"6_nexmark_{q}"] = {
+            "rate": round(rq, 1), "windows": wq,
+            "rate_unfused": round(rq0, 1),
+            "fused_delta": round(rq / rq0, 2)}
+    # the record plane (Python-callable chain, natively un-lowerable):
+    # the config where the per-hop cv round trip was the whole cost
+    r7_0, _c7 = run_record_chain_host(200_000,
+                                      opt_level=OptLevel.LEVEL0)
+    r7, c7 = run_record_chain_host(200_000, opt_level=OptLevel.LEVEL2)
+    configs["7_record_chain_host"] = {
+        "rate": round(r7, 1), "records": c7,
+        "rate_unfused": round(r7_0, 1),
+        "fused_delta": round(r7 / r7_0, 2)}
     for name, c in configs.items():
+        n_out = c.get("windows", c.get("records", 0))
         print(f"[bench] {name}: {c['rate']:,.0f} tuples/s "
-              f"({c['windows']} windows)", file=sys.stderr)
+              f"({n_out} outputs)", file=sys.stderr)
     base_s = f"{base_rate:,.0f}" if base_rate else "n/a"
     fused_s = f"{fused_rate:,.0f}" if fused_rate else "n/a"
     print(f"[bench] {backend}: headline {rate2:,.0f} tuples/s "

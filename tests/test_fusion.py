@@ -1,0 +1,485 @@
+"""Graph compile pass (graph/fuse.py): LEVEL0 vs LEVEL2 equivalence.
+
+Every test runs a representative graph at OptLevel.LEVEL0 (fusion off)
+and LEVEL2 (the default) and asserts identical outputs, dead-letter
+counts and stats totals -- the acceptance contract of the compile pass:
+fusion may only remove channel hops, never change results.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core.basic import OptLevel, RuntimeConfig
+from windflow_tpu.core.tuples import ColumnPool, TupleBatch
+from windflow_tpu.graph.fuse import find_logic, iter_logics
+from windflow_tpu.graph.pipegraph import NodeFailureError
+from windflow_tpu.operators.basic_ops import Sink
+from windflow_tpu.operators.batch_ops import BatchMap, BatchSource
+from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
+from windflow_tpu.resilience.faults import FaultPlan, InjectedFailure
+
+
+def record_source(n, n_keys=3):
+    state = {"i": 0}
+
+    def fn(shipper):
+        i = state["i"]
+        if i >= n:
+            return False
+        shipper.push(wf.BasicRecord(i % n_keys, i // n_keys, i // n_keys,
+                                    float(i)))
+        state["i"] = i + 1
+        return True
+
+    return fn
+
+
+def batch_source(n, n_keys=8, batch=1024, vmod=97):
+    state = {"i": 0}
+
+    def fn(ctx):
+        i = state["i"]
+        if i >= n:
+            return None
+        m = min(batch, n - i)
+        idx = i + np.arange(m)
+        state["i"] = i + m
+        return TupleBatch({"key": idx % n_keys, "id": idx // n_keys,
+                           "ts": idx // n_keys,
+                           "value": (idx % vmod).astype(np.float64)})
+
+    return fn
+
+
+class CollectSink:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.items = []
+
+    def __call__(self, item):
+        if item is None:
+            return
+        with self.lock:
+            if isinstance(item, TupleBatch):
+                for j in range(len(item)):
+                    self.items.append((int(item.key[j]), int(item.id[j]),
+                                       float(item["value"][j])))
+            else:
+                self.items.append((item.key, item.id, item.value))
+
+    def sorted(self):
+        return sorted(self.items)
+
+
+def cfg_for(level, **kw):
+    return RuntimeConfig(opt_level=level, **kw)
+
+
+# ---------------------------------------------------------------------------
+# result equivalence
+# ---------------------------------------------------------------------------
+
+def test_record_chain_equivalence_and_thread_collapse():
+    results, threads = {}, {}
+    for lvl in (OptLevel.LEVEL0, OptLevel.LEVEL2):
+        sink = CollectSink()
+        g = wf.PipeGraph("chain", wf.Mode.DEFAULT, config=cfg_for(lvl))
+        g.add_source(wf.SourceBuilder(record_source(300)).build()) \
+            .add(wf.MapBuilder(lambda t: wf.BasicRecord(
+                t.key, t.id, t.ts, t.value * 2.0)).build()) \
+            .add(wf.FilterBuilder(lambda t: t.value % 4 == 0).build()) \
+            .add_sink(wf.SinkBuilder(sink).build())
+        g.run()
+        results[lvl] = sink.sorted()
+        threads[lvl] = g.thread_count()
+    assert results[OptLevel.LEVEL0] == results[OptLevel.LEVEL2]
+    assert threads[OptLevel.LEVEL2] == 1  # whole chain in one replica
+    assert threads[OptLevel.LEVEL0] == 4
+
+
+def test_flatmap_chain_equivalence():
+    def dup(t, shipper):
+        shipper.push(t)
+        shipper.push(wf.BasicRecord(t.key, t.id, t.ts, -t.value))
+
+    results = {}
+    for lvl in (OptLevel.LEVEL0, OptLevel.LEVEL2):
+        sink = CollectSink()
+        g = wf.PipeGraph("fm", wf.Mode.DEFAULT, config=cfg_for(lvl))
+        g.add_source(wf.SourceBuilder(record_source(120)).build()) \
+            .add(wf.FlatMapBuilder(dup).build()) \
+            .add_sink(wf.SinkBuilder(sink).build())
+        g.run()
+        results[lvl] = sink.sorted()
+    assert results[OptLevel.LEVEL0] == results[OptLevel.LEVEL2]
+    assert len(results[OptLevel.LEVEL0]) == 240
+
+
+def test_parallel_forward_stage_pattern_fuses():
+    """n:n FORWARD fusion: same-parallelism map stage pairs off with
+    its upstream tails; the output multiset is unchanged."""
+    results, threads = {}, {}
+    for lvl in (OptLevel.LEVEL0, OptLevel.LEVEL2):
+        sink = CollectSink()
+        g = wf.PipeGraph("par", wf.Mode.DEFAULT, config=cfg_for(lvl))
+        g.add_source(wf.SourceBuilder(record_source(400)).build()) \
+            .add(wf.MapBuilder(lambda t: wf.BasicRecord(
+                t.key, t.id, t.ts, t.value + 1.0))
+                 .with_parallelism(2).build()) \
+            .add(wf.MapBuilder(lambda t: wf.BasicRecord(
+                t.key, t.id, t.ts, t.value * 3.0))
+                 .with_parallelism(2).build()) \
+            .add_sink(wf.SinkBuilder(sink).build())
+        g.run()
+        results[lvl] = sink.sorted()
+        threads[lvl] = g.thread_count()
+    assert results[OptLevel.LEVEL0] == results[OptLevel.LEVEL2]
+    # the two 2-replica map stages fused pairwise (4 nodes -> 2)
+    assert threads[OptLevel.LEVEL2] < threads[OptLevel.LEVEL0]
+
+
+@pytest.mark.parametrize("force_python", [False, True])
+def test_keyed_window_equivalence(force_python):
+    """Keyed TB window sums must be bitwise identical across levels,
+    on both the native C++ engine and the pure-Python path."""
+    results = {}
+    for lvl in (OptLevel.LEVEL0, OptLevel.LEVEL2):
+        sink = CollectSink()
+        g = wf.PipeGraph("win", wf.Mode.DEFAULT, config=cfg_for(lvl))
+        op = WinSeqTPU("sum", 64, 32, wf.WinType.TB, batch_len=128,
+                       emit_batches=True)
+        g.add_source(BatchSource(batch_source(50_000))) \
+            .add(BatchMap(lambda b: b.with_cols(value=b["value"] * 0.5))) \
+            .add(op).add_sink(Sink(sink))
+        if force_python:
+            for _name, logic in iter_logics(g):
+                if hasattr(logic, "_native"):
+                    logic._native = None
+        g.run()
+        results[lvl] = sink.sorted()
+    assert results[OptLevel.LEVEL0] == results[OptLevel.LEVEL2]
+    assert results[OptLevel.LEVEL0], "no windows emitted"
+
+
+@pytest.mark.parametrize("query", ["q5", "q7"])
+def test_nexmark_equivalence(query):
+    from windflow_tpu.models.nexmark import (build_q5_hot_items,
+                                             build_q7_highest_bid)
+    results = {}
+    for lvl in (OptLevel.LEVEL0, OptLevel.LEVEL2):
+        sink = CollectSink()
+        g = wf.PipeGraph(f"nex_{query}", wf.Mode.DEFAULT,
+                         config=cfg_for(lvl))
+        if query == "q5":
+            build_q5_hot_items(g, 60_000, 1 << 12, 1 << 11, sink,
+                               batch_size=4096, device_batch=512)
+        else:
+            build_q7_highest_bid(g, 60_000, 1 << 12, sink,
+                                 batch_size=4096, device_batch=512)
+        g.run()
+        results[lvl] = sink.sorted()
+    assert results[OptLevel.LEVEL0] == results[OptLevel.LEVEL2]
+    assert results[OptLevel.LEVEL0], "no windows emitted"
+
+
+# ---------------------------------------------------------------------------
+# containment contracts inside fused segments
+# ---------------------------------------------------------------------------
+
+def dl_graph(lvl):
+    sink = CollectSink()
+
+    def bad(t):
+        if t.id % 5 == 2:
+            raise ValueError("poison")
+        return t
+
+    g = wf.PipeGraph("dl", wf.Mode.DEFAULT, config=cfg_for(lvl))
+    g.add_source(wf.SourceBuilder(record_source(100, n_keys=1)).build()) \
+        .add(wf.MapBuilder(bad).with_error_policy("dead_letter")
+             .with_name("badmap").build()) \
+        .add_sink(wf.SinkBuilder(sink).build())
+    return g, sink
+
+
+def test_dead_letter_policy_parity():
+    out = {}
+    for lvl in (OptLevel.LEVEL0, OptLevel.LEVEL2):
+        g, sink = dl_graph(lvl)
+        g.run()
+        out[lvl] = (sink.sorted(), g.dead_letters.count(),
+                    g.dead_letters.counts_by_node())
+    assert out[OptLevel.LEVEL0] == out[OptLevel.LEVEL2]
+    # attribution names the fused-away operator's replica, not the host
+    assert out[OptLevel.LEVEL2][2] == {"pipe0/badmap.0": 20}
+
+
+def test_fault_plan_fires_inside_fused_segment():
+    for lvl in (OptLevel.LEVEL0, OptLevel.LEVEL2):
+        with FaultPlan(seed=11).crash_replica("mapper", at_tuple=17) as plan:
+            g = wf.PipeGraph("cr", wf.Mode.DEFAULT,
+                             config=cfg_for(lvl, fault_plan=plan))
+            g.add_source(wf.SourceBuilder(record_source(500)).build()) \
+                .add(wf.MapBuilder(lambda t: t).with_name("mapper")
+                     .build()) \
+                .add_sink(wf.SinkBuilder(lambda r: None).build())
+            with pytest.raises(NodeFailureError) as ei:
+                g.run()
+            assert any(isinstance(e, InjectedFailure)
+                       for _, e in ei.value.errors), lvl
+
+
+def test_skip_policy_does_not_swallow_neighbour_errors():
+    """A fused 'skip' segment must quarantine only its own failures:
+    an error in the downstream 'fail' segment still kills the graph."""
+
+    def skippy(t):
+        if t.id == 3:
+            raise ValueError("skippable")
+        return t
+
+    def bad_sink(r):
+        if r is not None and r.id == 7:
+            raise RuntimeError("sink failure must be fatal")
+
+    g = wf.PipeGraph("mix", wf.Mode.DEFAULT,
+                     config=cfg_for(OptLevel.LEVEL2))
+    g.add_source(wf.SourceBuilder(record_source(100, n_keys=1)).build()) \
+        .add(wf.MapBuilder(skippy).with_error_policy("skip").build()) \
+        .add_sink(wf.SinkBuilder(bad_sink).build())
+    with pytest.raises(NodeFailureError):
+        g.run()
+
+
+def test_stats_totals_match_across_levels():
+    totals = {}
+    for lvl in (OptLevel.LEVEL0, OptLevel.LEVEL2):
+        sink = CollectSink()
+        g = wf.PipeGraph("tr", wf.Mode.DEFAULT,
+                         config=cfg_for(lvl, tracing=True, log_dir="log"))
+        g.add_source(wf.SourceBuilder(record_source(200)).build()) \
+            .add(wf.MapBuilder(lambda t: t).with_name("m1").build()) \
+            .add(wf.FilterBuilder(lambda t: t.value % 2 == 0).build()) \
+            .add_sink(wf.SinkBuilder(sink).build())
+        g.run()
+        import json
+        data = json.loads(g.stats.to_json())
+        totals[lvl] = {
+            o["Operator_name"]: (
+                sum(r["Inputs_received"] for r in o["Replicas"]),
+                sum(r["Outputs_sent"] for r in o["Replicas"]),
+                all(r["Terminated"] for r in o["Replicas"]))
+            for o in data["Operators"]}
+    assert totals[OptLevel.LEVEL0] == totals[OptLevel.LEVEL2]
+
+
+def test_checkpoint_round_trip_across_fusion_levels():
+    """Snapshots stay keyed by pre-fusion node names: a LEVEL2 run's
+    state restores into a LEVEL0 graph (and the restored run agrees)."""
+    from windflow_tpu.utils.checkpoint import graph_state
+
+    def build(lvl, n):
+        sink = CollectSink()
+        g = wf.PipeGraph("ck", wf.Mode.DEFAULT, config=cfg_for(lvl))
+        g.add_source(wf.SourceBuilder(record_source(n, n_keys=2)).build()) \
+            .add(wf.AccumulatorBuilder(
+                lambda t, acc: setattr(acc, "value", acc.value + t.value))
+                .with_initial_value(wf.BasicRecord(value=0.0)).build()) \
+            .add_sink(wf.SinkBuilder(sink).build())
+        return g
+
+    g2 = build(OptLevel.LEVEL2, 40)
+    g2.run()
+    assert g2.fused_nodes, "accumulator chain should have fused"
+    snap = graph_state(g2)
+    # keys are the ORIGINAL node names, not the fused node's
+    assert any("accumulator" in k for k in snap)
+
+    g0 = build(OptLevel.LEVEL0, 40)
+    for node in g0._all_nodes():
+        st = snap.get(node.name)
+        if st is not None:
+            node.logic.load_state(st)
+    acc = find_logic(g0, lambda lg: hasattr(lg, "state"), "accumulator")
+    keys0 = {k: v.value for k, v in acc.state.items()}
+    acc2 = find_logic(g2, lambda lg: hasattr(lg, "state"), "accumulator")
+    keys2 = {k: v.value for k, v in acc2.state.items()}
+    assert keys0 == keys2 and keys2
+
+
+def test_deterministic_mode_unaffected():
+    """Collector-guarded modes never fuse across collectors; results
+    stay ordered and identical."""
+    results = {}
+    for lvl in (OptLevel.LEVEL0, OptLevel.LEVEL2):
+        sink = CollectSink()
+        g = wf.PipeGraph("det", wf.Mode.DETERMINISTIC, config=cfg_for(lvl))
+        g.add_source(wf.SourceBuilder(record_source(150)).build()) \
+            .add(wf.MapBuilder(lambda t: t).build()) \
+            .add_sink(wf.SinkBuilder(sink).build())
+        g.run()
+        results[lvl] = sink.items  # arrival order matters here
+    assert sorted(results[OptLevel.LEVEL0]) \
+        == sorted(results[OptLevel.LEVEL2])
+
+
+def test_opt_out_is_honoured():
+    g = wf.PipeGraph("off", wf.Mode.DEFAULT,
+                     config=cfg_for(OptLevel.LEVEL0))
+    g.add_source(wf.SourceBuilder(record_source(10)).build()) \
+        .add_sink(wf.SinkBuilder(lambda r: None).build())
+    g.run()
+    assert g.fused_nodes == []
+    assert g.thread_count() == 2
+
+
+# ---------------------------------------------------------------------------
+# batched channel plane
+# ---------------------------------------------------------------------------
+
+def test_channel_put_many_get_many_roundtrip():
+    from windflow_tpu.runtime.queues import Channel
+    ch = Channel(capacity=8)
+    pid = ch.register_producer()
+    ch.put_many(pid, list(range(6)))
+    got = ch.get_many(4)
+    assert [it for _, it in got] == [0, 1, 2, 3]
+    got = ch.get_many(10)
+    assert [it for _, it in got] == [4, 5]
+    ch.close(pid)
+    assert ch.get_many(4) is None
+    assert ch.get_many(4) is None  # sticky
+
+
+def test_channel_put_many_respects_capacity_and_poison():
+    from windflow_tpu.resilience.cancel import GraphCancelled
+    from windflow_tpu.runtime.queues import Channel
+    ch = Channel(capacity=4)
+    pid = ch.register_producer()
+    done = []
+
+    def producer():
+        try:
+            ch.put_many(pid, list(range(100)))
+            done.append("full")
+        except GraphCancelled:
+            done.append("cancelled")
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    got = []
+    while len(got) < 20:
+        out = ch.get_many(8, timeout=1.0)
+        assert isinstance(out, list)
+        got.extend(it for _, it in out)
+    assert got == list(range(len(got)))  # FIFO preserved across bulk ops
+    ch.poison()
+    t.join(timeout=5)
+    assert not t.is_alive() and done and done[0] == "cancelled"
+
+
+def test_get_many_interleaves_multiple_producers_eos():
+    from windflow_tpu.runtime.queues import Channel
+    ch = Channel(capacity=16)
+    p0, p1 = ch.register_producer(), ch.register_producer()
+    ch.put(p0, "a")
+    ch.close(p0)
+    ch.put(p1, "b")
+    out = ch.get_many(8)
+    assert [it for _, it in out] == ["a", "b"]  # p0's EOS absorbed
+    ch.close(p1)
+    assert ch.get_many(8) is None
+
+
+# ---------------------------------------------------------------------------
+# pooled interchange
+# ---------------------------------------------------------------------------
+
+def test_column_pool_reuses_dead_buffers():
+    pool = ColumnPool()
+    a = pool.take(1000, np.int64)
+    a_base_id = id(a.base)
+    del a
+    b = pool.take(900, np.int64)  # same power-of-two bucket
+    assert id(b.base) == a_base_id
+    assert pool.hits == 1
+
+
+def test_column_pool_never_reuses_live_buffers():
+    pool = ColumnPool()
+    a = pool.take(100, np.float64)
+    a[:] = 7.0
+    b = pool.take(100, np.float64)
+    assert id(b.base) != id(a.base)
+    b[:] = 9.0
+    assert float(a[0]) == 7.0
+
+
+def test_synth_chunk_pooled_materialize_identical():
+    from windflow_tpu.core.tuples import SynthChunk
+    pool = ColumnPool()
+    c = SynthChunk(1234, 5000, 7, 97, 1.5, 0.25)
+    plain = c.materialize()
+    pooled = c.materialize(pool)
+    for col in ("key", "id", "ts", "value"):
+        np.testing.assert_array_equal(plain[col], pooled[col])
+
+
+def test_take_contiguous_run_is_view():
+    b = TupleBatch({"key": np.arange(10), "id": np.arange(10),
+                    "ts": np.arange(10),
+                    "value": np.arange(10, dtype=np.float64)})
+    mask = np.zeros(10, bool)
+    mask[3:9] = True
+    sub = b.take(mask)
+    assert len(sub) == 6
+    assert sub.key.base is not None  # a view, not a gather copy
+    np.testing.assert_array_equal(sub.key, np.arange(3, 9))
+    # non-contiguous picks still gather correctly
+    sub2 = b.take(np.array([0, 2, 3]))
+    np.testing.assert_array_equal(sub2.key, [0, 2, 3])
+
+
+def test_partition_batch_pooled_matches_unpooled():
+    from windflow_tpu.runtime.emitters import partition_batch
+    rng = np.random.default_rng(0)
+    b = TupleBatch({"key": rng.integers(0, 50, 4096),
+                    "id": np.arange(4096), "ts": np.arange(4096),
+                    "value": rng.random(4096)})
+    dests = np.abs(b.key) % 4
+    plain = {d: s for d, s in partition_batch(b, dests)}
+    pooled = {d: s for d, s in partition_batch(b, dests, ColumnPool())}
+    assert plain.keys() == pooled.keys()
+    for d in plain:
+        for col in ("key", "id", "ts", "value"):
+            np.testing.assert_array_equal(plain[d][col], pooled[d][col])
+
+
+def test_ingest_feed_fused_equivalence():
+    """Ingest plane + LEVEL2: the credit boundary survives (the source
+    keeps its outlet channel) while the engine fuses with the sink."""
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPULogic
+    n = 40_000
+    arange = np.arange(n, dtype=np.int64)
+    ids = arange // 4
+    trace = TupleBatch({"key": arange % 4, "id": ids, "ts": ids,
+                        "value": (arange % 31).astype(np.float64)})
+    results = {}
+    for lvl in (OptLevel.LEVEL0, OptLevel.LEVEL2):
+        sink = CollectSink()
+        src = wf.SourceBuilder.from_replay(trace, speedup=None,
+                                           chunk=2048).build()
+        g = wf.PipeGraph("ing", wf.Mode.DEFAULT, config=cfg_for(lvl))
+        op = WinSeqTPU("sum", 256, 128, wf.WinType.TB, batch_len=256,
+                       emit_batches=True)
+        g.add_source(src).add(op).add_sink(Sink(sink))
+        g.run()
+        results[lvl] = sink.sorted()
+        if lvl == OptLevel.LEVEL2:
+            assert g.fused_nodes, "engine+sink should have fused"
+            eng = find_logic(g, lambda lg: isinstance(lg, WinSeqTPULogic))
+            assert eng is not None  # fusion-transparent lookup
+    assert results[OptLevel.LEVEL0] == results[OptLevel.LEVEL2]
+    assert results[OptLevel.LEVEL0], "no windows emitted"

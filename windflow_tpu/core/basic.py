@@ -38,7 +38,16 @@ class WinType(enum.Enum):
 
 
 class OptLevel(enum.IntEnum):
-    """Optimization levels for composite window operators (basic.hpp:92)."""
+    """Optimization levels (basic.hpp:92).
+
+    Composite window operators take an ``opt_level`` per builder
+    (LEVEL1 strips internal collectors, LEVEL2 thread-fuses their
+    stages).  The same enum also grades the **graph compile pass**
+    (:mod:`windflow_tpu.graph.fuse`, ``RuntimeConfig.opt_level``):
+    at LEVEL2 -- the default -- ``PipeGraph.start`` fuses maximal runs
+    of adjacent single-producer FORWARD stages into single replicas
+    (the ``ff_comb`` fusion of multipipe.hpp:345-390, applied
+    automatically graph-wide)."""
 
     LEVEL0 = 0  # no optimization
     LEVEL1 = 1  # strip internal collectors where ordering is not required
@@ -189,3 +198,14 @@ class RuntimeConfig:
     # default per-source-replica credit budget (tuples outstanding in
     # outlet channels before the transport stops reading)
     ingest_credits: int = 1 << 16
+    # -- graph compile pass (graph/fuse.py; docs/RUNTIME.md) ------------
+    # LEVEL2 (default): PipeGraph.start fuses maximal runs of adjacent
+    # single-producer FORWARD stages into one replica thread each,
+    # preserving per-segment error policies / stats / faults /
+    # checkpoint state.  Set LEVEL0 (or LEVEL1) to opt out.
+    opt_level: "OptLevel" = OptLevel.LEVEL2
+    # per-graph column-buffer pool (core/tuples.ColumnPool): partition
+    # sub-batches, SynthChunk materialization and ingest staging reuse
+    # arena buffers instead of allocating per batch.  False = every
+    # batch allocates fresh numpy columns (the pre-pool behaviour).
+    buffer_pool: bool = True

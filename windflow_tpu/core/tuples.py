@@ -10,9 +10,74 @@ which is what XLA wants; single records exist only at the API edge.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Iterator, Protocol, Tuple, runtime_checkable
+import sys
+import threading
+from typing import Any, Dict, Iterator, Optional, Protocol, Tuple, \
+    runtime_checkable
 
 import numpy as np
+
+
+class ColumnPool:
+    """Arena of reusable numpy column buffers (per PipeGraph).
+
+    ``take(n, dtype)`` returns a length-``n`` view over a pooled
+    power-of-two buffer.  Reuse is **refcount-driven**: the pool keeps a
+    strong reference to every base buffer it handed out; a buffer whose
+    only remaining referent is the pool itself (every downstream view
+    of it has died) is free and gets re-lent.  No explicit release call
+    exists, so a consumer holding a batch alive can never have its
+    columns scribbled over -- the safety property an explicit-free
+    arena cannot give a Python dataflow.
+
+    The per-(dtype, bucket) freelists are bounded (``max_per_bucket``)
+    so a burst of in-flight batches degrades to plain allocation
+    instead of growing the arena without bound.
+    """
+
+    __slots__ = ("_lock", "_buckets", "max_per_bucket", "hits", "misses")
+
+    # refcount of a free base buffer: the bucket list + the loop local
+    # + the getrefcount argument
+    _FREE_RC = 3
+
+    def __init__(self, max_per_bucket: int = 32):
+        self._lock = threading.Lock()
+        self._buckets: Dict[Tuple[str, int], list] = {}
+        self.max_per_bucket = max_per_bucket
+        self.hits = 0
+        self.misses = 0
+
+    def take(self, n: int, dtype) -> np.ndarray:
+        """A length-``n`` uninitialized view over a pooled buffer."""
+        dt = np.dtype(dtype)
+        if n <= 0:
+            return np.empty(0, dt)
+        cap = 1 << (int(n) - 1).bit_length()
+        key = (dt.str, cap)
+        with self._lock:
+            bucket = self._buckets.get(key)
+            if bucket is not None:
+                for buf in bucket:
+                    # free iff nothing outside this pool references it
+                    if sys.getrefcount(buf) <= self._FREE_RC:
+                        self.hits += 1
+                        return buf[:n]
+            self.misses += 1
+            buf = np.empty(cap, dt)
+            if bucket is None:
+                bucket = self._buckets[key] = []
+            if len(bucket) < self.max_per_bucket:
+                bucket.append(buf)
+            return buf[:n]
+
+    def stats(self) -> dict:
+        with self._lock:
+            held = sum(len(b) for b in self._buckets.values())
+            held_bytes = sum(buf.nbytes for b in self._buckets.values()
+                             for buf in b)
+        return {"buffers": held, "bytes": held_bytes,
+                "hits": self.hits, "misses": self.misses}
 
 
 @runtime_checkable
@@ -51,13 +116,28 @@ class SynthChunk:
     def __len__(self):
         return self.n
 
-    def materialize(self) -> "TupleBatch":
-        idx = self.start + np.arange(self.n)
-        ids = idx // self.n_keys
-        return TupleBatch({
-            "key": idx % self.n_keys, "id": ids, "ts": ids,
-            "value": (idx % self.vmod).astype(np.float64) * self.vscale
-                     + self.voff})
+    def materialize(self, pool: Optional[ColumnPool] = None) -> "TupleBatch":
+        if pool is None:
+            idx = self.start + np.arange(self.n)
+            ids = idx // self.n_keys
+            return TupleBatch({
+                "key": idx % self.n_keys, "id": ids, "ts": ids,
+                "value": (idx % self.vmod).astype(np.float64) * self.vscale
+                         + self.voff})
+        # pooled lane: all columns come from the graph arena;
+        # np.ufunc(..., out=) writes them in place (no fresh allocation
+        # per chunk)
+        n = self.n
+        idx = pool.take(n, np.int64)
+        idx[:] = np.arange(self.start, self.start + n)
+        keys = np.mod(idx, self.n_keys, out=pool.take(n, np.int64))
+        res = np.mod(idx, self.vmod, out=pool.take(n, np.int64))
+        ids = np.floor_divide(idx, self.n_keys, out=idx)  # idx is scratch
+        vals = np.multiply(res, self.vscale, out=pool.take(n, np.float64),
+                           casting="unsafe")
+        if self.voff:
+            np.add(vals, self.voff, out=vals)
+        return TupleBatch({"key": keys, "id": ids, "ts": ids, "value": vals})
 
 
 class BasicRecord:
@@ -159,11 +239,13 @@ class TupleBatch:
         return [c for c in self.cols if c not in self.CONTROL]
 
     # -- transforms --------------------------------------------------------
-    def take(self, idx) -> "TupleBatch":
+    def take(self, idx, pool: Optional[ColumnPool] = None) -> "TupleBatch":
         """Row subset.  Slices stay zero-copy views; boolean masks are
         converted to indices once and gathered with np.take, which is
         4-5x faster than boolean fancy indexing repeated per column
-        (the filter stages live on this path)."""
+        (the filter stages live on this path).  A contiguous index run
+        ships as a slice view (zero copies); with ``pool`` the gathered
+        columns reuse arena buffers instead of allocating."""
         if isinstance(idx, slice):
             return TupleBatch({k: v[idx] for k, v in self.cols.items()})
         idx = np.asarray(idx)
@@ -175,8 +257,25 @@ class TupleBatch:
             idx = np.nonzero(idx)[0]
         elif idx.size == 0:
             idx = idx.astype(np.intp)   # e.g. a bare [] (float64)
-        return TupleBatch({k: np.take(v, idx, axis=0)
-                           for k, v in self.cols.items()})
+        n = len(idx)
+        if n > 1 and int(idx[-1]) - int(idx[0]) == n - 1 \
+                and bool((np.diff(idx) == 1).all()):
+            # contiguous ascending run: zero-copy view instead of a
+            # gather (the cheap first/last guard gates the O(n) check)
+            lo = int(idx[0])
+            return TupleBatch({k: v[lo:lo + n]
+                               for k, v in self.cols.items()})
+        if pool is None:
+            return TupleBatch({k: np.take(v, idx, axis=0)
+                               for k, v in self.cols.items()})
+        out = {}
+        for k, v in self.cols.items():
+            if v.base is not None and not v.flags.owndata \
+                    and not v.flags.c_contiguous:
+                out[k] = np.take(v, idx, axis=0)  # odd layout: let numpy
+                continue
+            out[k] = np.take(v, idx, axis=0, out=pool.take(n, v.dtype))
+        return TupleBatch(out)
 
     def concat(self, other: "TupleBatch") -> "TupleBatch":
         return TupleBatch(

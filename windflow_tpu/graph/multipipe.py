@@ -127,6 +127,7 @@ class MultiPipe:
                 coll_node = RtNode(
                     f"{self.name}/{stage.name}.coll{i}", collector_logics[i],
                     entry_channels[i], [])
+                coll_node.is_collector = True
                 fwd = StandardEmitter()
                 fwd.set_n_destinations(1)
                 coll_node.outlets.append(
@@ -158,6 +159,7 @@ class MultiPipe:
                 cch = make_channel(cfg)
                 cnode = RtNode(f"{self.name}/{stage.name}.coll.g{g}", coll,
                                cch, [])
+                cnode.is_collector = True
                 cnode.group = g
                 if hasattr(coll, "set_n_channels"):
                     coll.set_n_channels(len(members))
@@ -173,6 +175,7 @@ class MultiPipe:
             cch = make_channel(cfg)
             cnode = RtNode(f"{self.name}/{stage.name}.collector",
                            stage.collector, cch, [])
+            cnode.is_collector = True
             if hasattr(stage.collector, "set_n_channels"):
                 stage.collector.set_n_channels(len(replica_nodes))
             for rn in replica_nodes:

@@ -55,6 +55,17 @@ class PipeGraph:
         self._cancel = CancelToken()
         self.dead_letters = DeadLetterStore()
         self._watchdog = None
+        # pooled zero-copy interchange (core/tuples.ColumnPool): one
+        # arena per graph, shared by partition sub-batches, SynthChunk
+        # materialization and the batched consume loops
+        if self.config.buffer_pool:
+            from ..core.tuples import ColumnPool
+            self.buffer_pool = ColumnPool()
+        else:
+            self.buffer_pool = None
+        # names of nodes the LEVEL2 compile pass fused (graph/fuse.py),
+        # filled at start()
+        self.fused_nodes: List[str] = []
 
     # -- construction ------------------------------------------------------
     def _new_pipe(self) -> MultiPipe:
@@ -197,8 +208,24 @@ class PipeGraph:
         # plus the failure-containment plumbing: the CancelToken learns
         # every channel, every node learns the token / dead-letter
         # store / any bound fault-injection state
-        from ..runtime.node import SourceLoopLogic, SourcePauseControl
+        from ..runtime.node import FusedLogic, SourcePauseControl, \
+            source_loop_of
         self._pause_ctl = SourcePauseControl()
+        # graph compile pass (graph/fuse.py): at OptLevel.LEVEL2 (the
+        # default; RuntimeConfig.opt_level opts out) adjacent
+        # single-producer FORWARD stages fuse into single replicas.
+        # Runs BEFORE the ingest wiring so credit proxies wrap the
+        # post-fusion channel set, and BEFORE the binding loop below so
+        # fault plans bind per fused segment.
+        from .fuse import fuse_graph
+        self.fused_nodes = fuse_graph(self)
+        # attach the column pool to every node and emitter (pooled
+        # materialization + partition sub-batches)
+        if self.buffer_pool is not None:
+            for n in self._all_nodes():
+                n.pool = self.buffer_pool
+                for o in n.outlets:
+                    o.emitter.pool = self.buffer_pool
         # ingest plane (ingest/wiring.py): wrap ingest outlet channels
         # in credit proxies, register gates/stages with the CancelToken
         # and bind the microbatch controller to downstream engines --
@@ -211,12 +238,21 @@ class PipeGraph:
             n.pause_ctl = self._pause_ctl
             n.cancel_token = self._cancel
             n.dead_letters = self.dead_letters
-            if fault_plan is not None:
+            if isinstance(n.logic, FusedLogic):
+                # per-segment identity: dead letters, fault clocks (a
+                # FaultPlan targeting a fused-away operator still fires)
+                for seg in n.logic.segments:
+                    seg.dead_letters = self.dead_letters
+                    if fault_plan is not None:
+                        seg.faults = fault_plan.for_node(seg.name)
+            elif fault_plan is not None:
                 n.faults = fault_plan.for_node(n.name)
             if n.channel is not None:
                 self._cancel.register(n.channel)
-            if n.channel is None and isinstance(n.logic, SourceLoopLogic):
-                n.logic.pause_control = self._pause_ctl
+            if n.channel is None:
+                src = source_loop_of(n.logic)
+                if src is not None:
+                    src.pause_control = self._pause_ctl
         for n in self._all_nodes():
             n.start()
         # watchdog AFTER the replica threads: it treats "no node alive"

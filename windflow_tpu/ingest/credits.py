@@ -185,6 +185,24 @@ class CreditedChannel:
             gate.acquire(credits_of(item))
         self.inner.put(producer_id, item)
 
+    def put_many(self, producer_id: int, items) -> None:
+        """Bulk put with EXACT credit accounting: each item's credits
+        are acquired immediately before its own delivery (never summed
+        up front -- a bulk acquire larger than the budget could wait on
+        releases only the not-yet-delivered prefix can produce)."""
+        gate = self.gates.get(producer_id)
+        if gate is None:
+            pm = getattr(self.inner, "put_many", None)
+            if pm is not None:
+                pm(producer_id, items)
+            else:
+                for item in items:
+                    self.inner.put(producer_id, item)
+            return
+        for item in items:
+            gate.acquire(credits_of(item))
+            self.inner.put(producer_id, item)
+
     def close(self, producer_id: int) -> None:
         self.inner.close(producer_id)
 
@@ -195,6 +213,22 @@ class CreditedChannel:
             gate = self.gates.get(pid)
             if gate is not None:
                 gate.release(credits_of(item))
+        return got
+
+    def get_many(self, max_n: int = 128, timeout: Optional[float] = None):
+        """Bulk get; every dequeued item returns its credits to its
+        producer's gate, exactly as the per-item path does."""
+        gm = getattr(self.inner, "get_many", None)
+        if gm is None:
+            got = self.get(timeout)
+            return [got] if isinstance(got, tuple) else got
+        got = gm(max_n, timeout)
+        if isinstance(got, list):
+            gates = self.gates
+            for pid, item in got:
+                gate = gates.get(pid)
+                if gate is not None:
+                    gate.release(credits_of(item))
         return got
 
     def poison(self) -> None:

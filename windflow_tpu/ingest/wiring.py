@@ -78,10 +78,20 @@ def wire_ingest(graph) -> None:
 def _bind_downstream(graph, logic: IngestSourceLogic,
                      consumers: List) -> None:
     """Controller steering + pane pre-reduction for directly-fed device
-    window engines."""
+    window engines.  A consumer the LEVEL2 compile pass fused is seen
+    through its FIRST segment -- that is the logic the source's items
+    actually enter (later segments receive window results, not raw
+    tuples, so they do not constrain granularity)."""
     from ..operators.tpu.win_seq_tpu import WinSeqTPULogic
-    engines = [c.logic for c in consumers
-               if isinstance(c.logic, WinSeqTPULogic)]
+    from ..runtime.node import FusedLogic
+
+    def entry_logic(c):
+        if isinstance(c.logic, FusedLogic):
+            return c.logic.segments[0].logic
+        return c.logic
+
+    engines = [entry_logic(c) for c in consumers
+               if isinstance(entry_logic(c), WinSeqTPULogic)]
     for eng in engines:
         logic.controller.bind_engine(eng)
     if logic.pre_reduce_mode in (False, None) or not consumers:

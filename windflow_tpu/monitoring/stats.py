@@ -34,9 +34,14 @@ class StatsRecord:
     # (resilience/policies.py); the replica stayed alive
     svc_failures: int = 0
     # EWMA service times (microseconds), updated inline like
-    # win_seq.hpp:499-509
+    # win_seq.hpp:499-509.  Since the batched-stats amortization
+    # (graph compile pass PR) observations are SAMPLED -- stride 1 for
+    # the first 64, then 1/16 (or once per get_many batch) -- so the
+    # mean runs over ``samples``, not ``inputs_received``; tracing no
+    # longer costs a perf_counter pair per tuple
     service_time_us: float = 0.0
     eff_service_time_us: float = 0.0
+    samples: int = 0
     # device metrics (TPU analogues of stats_record.hpp:77-79)
     num_launches: int = 0
     bytes_to_device: int = 0
@@ -52,8 +57,9 @@ class StatsRecord:
     controller_trace: list = field(default_factory=list)
 
     def observe(self, elapsed_us: float) -> None:
-        n = max(1, self.inputs_received)
-        self.service_time_us += (elapsed_us - self.service_time_us) / n
+        self.samples += 1
+        self.service_time_us += \
+            (elapsed_us - self.service_time_us) / self.samples
 
     def set_terminated(self) -> None:
         self.terminated = True

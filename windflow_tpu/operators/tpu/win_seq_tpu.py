@@ -200,6 +200,10 @@ class _TPUKeyState:
 class WinSeqTPULogic(NodeLogic):
     # the runtime hands SynthChunk descriptors through un-materialized
     accepts_synth_chunks = True
+    # async dispatch calls emit from the dispatcher thread AFTER svc
+    # returns: the runtime must not hand this logic a buffered emit
+    # (set per instance in __init__; inline dispatch is synchronous)
+    sync_emit = False
 
     def __init__(self, win_kind: Any, win_len: int, slide_len: int,
                  win_type: WinType, *, batch_len: int = DEFAULT_BATCH_LEN,
@@ -241,6 +245,7 @@ class WinSeqTPULogic(NodeLogic):
         self.pending = deque()
         self.inflight_depth = max(1, inflight_depth)
         self.async_dispatch = async_dispatch
+        self.sync_emit = not async_dispatch
         self._dispatcher: Optional[_AsyncDispatcher] = None
         self.ignored_tuples = 0
         self.launched_batches = 0

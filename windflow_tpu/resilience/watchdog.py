@@ -94,9 +94,17 @@ class StallWatchdog(threading.Thread):
         self.report_path: Optional[str] = None
 
     def _progress(self) -> int:
+        from ..runtime.node import FusedLogic
         total = 0
         for n in self.graph._all_nodes():
             total += n.done
+            if isinstance(n.logic, FusedLogic):
+                # fused stages process inline (no channel hop): their
+                # per-segment take counters are the progress signal --
+                # without them a fully fused source-headed pipeline
+                # would look stalled forever
+                for seg in n.logic.segments:
+                    total += seg.taken
             ch = n.channel
             if ch is not None:
                 total += getattr(ch, "gets", 0)

@@ -10,7 +10,10 @@ from __future__ import annotations
 import copy
 from typing import Any, Callable, List, Optional
 
+import numpy as np
+
 from ..core.meta import default_hash
+from ..core.tuples import TupleBatch
 from .node import EOSMarker
 
 SendTo = Callable[[int, Any], None]
@@ -18,6 +21,9 @@ SendTo = Callable[[int, Any], None]
 
 class Emitter:
     n_dest: int = 1
+    # per-graph ColumnPool for partition sub-batches (attached by the
+    # graph compile pass at start; None = allocate fresh columns)
+    pool = None
 
     def set_n_destinations(self, n: int) -> None:
         self.n_dest = n
@@ -43,7 +49,6 @@ class StandardEmitter(Emitter):
         self._rr = 0
 
     def emit(self, item, send_to):
-        from ..core.tuples import TupleBatch
         if self.n_dest == 1:
             send_to(0, item)
         elif isinstance(item, TupleBatch):
@@ -52,9 +57,8 @@ class StandardEmitter(Emitter):
                 self._rr = (self._rr + 1) % self.n_dest
             else:
                 # vectorized KEYBY: partition the batch by key hash
-                import numpy as np
                 dests = np.abs(item.key) % self.n_dest
-                for d, sub in partition_batch(item, dests):
+                for d, sub in partition_batch(item, dests, self.pool):
                     send_to(d, sub)
         elif self.keyed:
             rec = item.record if isinstance(item, EOSMarker) else item
@@ -63,16 +67,50 @@ class StandardEmitter(Emitter):
             send_to(self._rr, item)
             self._rr = (self._rr + 1) % self.n_dest
 
+    def emit_many(self, items, send_to: SendTo, send_many_to) -> None:
+        """Batched-emission plane (Outlet.send_many): route a whole
+        buffer, accumulating same-destination items -- including the
+        sub-batches of a partitioned TupleBatch -- into one bulk
+        transfer per destination.  Per-destination arrival order is
+        identical to per-item emit."""
+        n = self.n_dest
+        if n == 1:
+            send_many_to(0, items)
+            return
+        buckets: dict = {}
+        pool = self.pool
+        for item in items:
+            if isinstance(item, TupleBatch):
+                if not self.keyed:
+                    d = self._rr
+                    self._rr = (self._rr + 1) % n
+                    buckets.setdefault(d, []).append(item)
+                else:
+                    dests = np.abs(item.key) % n
+                    for d, sub in partition_batch(item, dests, pool):
+                        buckets.setdefault(int(d), []).append(sub)
+            elif self.keyed:
+                rec = item.record if isinstance(item, EOSMarker) else item
+                d = default_hash(self.key_of(rec)) % n
+                buckets.setdefault(d, []).append(item)
+            else:
+                d = self._rr
+                self._rr = (self._rr + 1) % n
+                buckets.setdefault(d, []).append(item)
+        for d, run in buckets.items():
+            send_many_to(d, run)
 
-def partition_batch(batch, dests):
+
+def partition_batch(batch, dests, pool=None):
     """Destination partition of a TupleBatch (shared by the KEYBY
     emitters).  A batch whose rows all route to one destination ships
     as-is (zero copies -- the common case for few-key streams); the
     multi-destination path uses one boolean-mask gather per
     destination, which measures faster than a sort-based single pass
     (the argsort dominates).  Mask selection preserves arrival order
-    within each destination.  Yields (dest, sub_batch)."""
-    import numpy as np
+    within each destination; contiguous runs ship as views and, with
+    ``pool``, gathered sub-batches reuse arena buffers (core/tuples).
+    Yields (dest, sub_batch)."""
     if len(dests) == 0:
         return
     lo_d, hi_d = int(dests.min()), int(dests.max())
@@ -80,7 +118,7 @@ def partition_batch(batch, dests):
         yield lo_d, batch
         return
     for d in np.unique(dests):
-        yield int(d), batch.take(dests == d)
+        yield int(d), batch.take(dests == d, pool)
 
 
 class BroadcastEmitter(Emitter):

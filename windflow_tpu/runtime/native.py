@@ -193,7 +193,7 @@ class NativeChannel:
     """Drop-in for runtime.queues.Channel backed by the C++ channel."""
 
     __slots__ = ("lib", "ptr", "n_producers", "capacity", "poisoned",
-                 "puts", "gets", "high_watermark")
+                 "puts", "gets", "high_watermark", "_all_closed")
 
     def __init__(self, capacity: int = 2048):
         self.lib = get_lib()
@@ -207,6 +207,7 @@ class NativeChannel:
         self.puts = 0
         self.gets = 0
         self.high_watermark = 0
+        self._all_closed = False  # sticky once every producer closed
 
     def register_producer(self) -> int:
         self.n_producers += 1
@@ -225,8 +226,39 @@ class NativeChannel:
         if d > self.high_watermark:
             self.high_watermark = d
 
+    def put_many(self, producer_id: int, items) -> None:
+        """Bulk put.  The C++ ring blocks with the GIL released per
+        item already; the win here is one Python-level call per batch
+        from the outlet plane (and API parity with the pure-Python
+        channel)."""
+        for item in items:
+            self.put(producer_id, item)
+
     def close(self, producer_id: int) -> None:
         self.lib.wfn_channel_close(self.ptr, producer_id)
+
+    def get_many(self, max_n: int = 128, timeout: Optional[float] = None):
+        """Bulk get: one blocking get, then opportunistic non-blocking
+        pops while the ring is non-empty.  Same return contract as
+        ``Channel.get_many`` (list / sticky None / CHANNEL_TIMEOUT)."""
+        if self._all_closed:
+            return None
+        got = self.get(timeout)
+        if got is CHANNEL_TIMEOUT:
+            return CHANNEL_TIMEOUT
+        if got is None:
+            self._all_closed = True
+            return None
+        out = [got]
+        while len(out) < max_n and self.qsize() > 0:
+            nxt = self.get(timeout=0.001)
+            if nxt is CHANNEL_TIMEOUT:
+                break  # the visible entry was an unresolved EOS token
+            if nxt is None:
+                self._all_closed = True
+                break
+            out.append(nxt)
+        return out
 
     def get(self, timeout: Optional[float] = None):
         handle = ctypes.c_size_t()

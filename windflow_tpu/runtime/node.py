@@ -18,7 +18,7 @@ from typing import Any, Callable, Optional, Sequence
 from ..core.tuples import SynthChunk
 from ..resilience.cancel import GraphCancelled
 from ..resilience.policies import POLICY_DEAD_LETTER, POLICY_FAIL
-from .queues import Channel, CHANNEL_TIMEOUT
+from .queues import Channel, CHANNEL_TIMEOUT, GET_MANY_MAX
 
 
 class EOSMarker:
@@ -36,6 +36,13 @@ class NodeLogic:
     """Base class for operator replica logic."""
 
     stats = None  # replica StatsRecord, attached by RtNode under tracing
+
+    # True (the default) promises every ``emit`` happens before the
+    # ``svc``/``eos_flush`` call that received the callback returns.
+    # Logics that stash ``emit`` and call it later from another thread
+    # (the window engines' async dispatcher) set False, which disables
+    # the runtime's batched-emission fast path for their node.
+    sync_emit = True
 
     def svc_init(self) -> None:
         pass
@@ -72,6 +79,12 @@ class ChainedLogic(NodeLogic):
         # does (the runtime materializes them otherwise)
         self.accepts_synth_chunks = getattr(a, "accepts_synth_chunks",
                                             False)
+        # a chain emits synchronously only if BOTH halves do: an async
+        # half (device engine dispatcher) calls the wrapped emit after
+        # svc returns, so the runtime must not hand the chain a
+        # buffered emit
+        self.sync_emit = (getattr(a, "sync_emit", True)
+                          and getattr(b, "sync_emit", True))
         # delegate idle ticks only when a half defines them: RtNode
         # probes hasattr, and unconditional definition would put every
         # fused map chain on timed gets for nothing
@@ -132,6 +145,244 @@ class ChainedLogic(NodeLogic):
             self.b.load_state(state["b"])
 
 
+class _FusedDownstreamError(BaseException):
+    """Carrier for an exception crossing a fused-segment boundary
+    upstream.  Deliberately a BaseException: an upstream segment's
+    ``except Exception`` policy guard must never swallow a DOWNSTREAM
+    segment's failure (at LEVEL0 it happens in another thread, out of
+    the upstream policy's scope).  FusedLogic unwraps it at the top."""
+
+    def __init__(self, error: BaseException):
+        self.error = error
+        super().__init__(str(error))
+
+
+class FusedSegment:
+    """One operator replica inside a :class:`FusedLogic`: the logic plus
+    the runtime identity it had (or would have had) as its own RtNode --
+    name, error policy, stats record, fault state, dead-letter store.
+    The fusion pass (graph/fuse.py) builds these; PipeGraph.start binds
+    faults per segment so a FaultPlan targeting a fused-away operator
+    still fires."""
+
+    __slots__ = ("logic", "name", "policy", "stats", "faults",
+                 "dead_letters", "taken", "accepts_chunks")
+
+    def __init__(self, logic: NodeLogic, name: str,
+                 policy: str = POLICY_FAIL):
+        self.logic = logic
+        self.name = name
+        self.policy = policy
+        self.stats = None
+        self.faults = None
+        self.dead_letters = None
+        self.taken = 0  # items entering this segment (1-based fault clock)
+        self.accepts_chunks = getattr(logic, "accepts_synth_chunks", False)
+
+
+class FusedLogic(NodeLogic):
+    """N-ary stage fusion: the segments run inline in one replica thread,
+    each emission feeding the next segment's ``svc`` directly (the
+    graph-wide generalization of :class:`ChainedLogic`, realizing
+    ``OptLevel.LEVEL2`` -- reference ``ff_comb``, multipipe.hpp:345-390
+    and pane_farm.hpp:222-250).
+
+    Unlike ``ChainedLogic`` (whose halves share the node's single error
+    policy, which is why ``chain()`` refuses policied operators), every
+    segment keeps its own error policy, stats record, fault-injection
+    state and checkpoint identity: a skip/dead_letter segment
+    quarantines its own tuples without swallowing its neighbours'
+    errors, and snapshots restore across fusion-level changes because
+    state stays keyed by the original node names
+    (utils/checkpoint.graph_state flattens segments)."""
+
+    def __init__(self, segments):
+        self.segments: list = []
+        for seg in segments:
+            if isinstance(seg.logic, FusedLogic):  # flatten nested fusion
+                self.segments.extend(seg.logic.segments)
+            else:
+                self.segments.append(seg)
+        first = self.segments[0]
+        self.accepts_synth_chunks = first.accepts_chunks
+        self.sync_emit = all(getattr(s.logic, "sync_emit", True)
+                             for s in self.segments)
+        self.pool = None            # graph ColumnPool (boundary
+        #                             materialization), set at fuse time
+        self._emit_out = None       # the node's outward emit, set per call
+        self._obs_left = 1          # sampled whole-chain service timing
+        self._entry0 = None
+        self._exits = None
+        self._build_chain()
+        # idle ticks delegate only when some segment defines them (the
+        # RtNode probes hasattr, exactly like ChainedLogic)
+        if any(hasattr(s.logic, "idle_tick") for s in self.segments):
+            self.idle_tick = self._idle_tick
+
+    # -- inline chain construction (closures built once) ----------------
+    def _build_chain(self):
+        segs = self.segments
+        n = len(segs)
+        exits = [None] * n
+        entry_next = None
+        for k in range(n - 1, -1, -1):
+            seg = segs[k]
+            exits[k] = self._make_exit(seg, entry_next)
+            entry_next = self._make_entry(seg, exits[k])
+        self._exits = exits
+        self._entry0 = entry_next
+
+    def _make_exit(self, seg: FusedSegment, entry_next):
+        if entry_next is None:      # last segment: leave the fused node
+            def exit_(item):
+                if seg.faults is not None:
+                    seg.faults.before_put()
+                if seg.stats is not None:
+                    seg.stats.outputs_sent += 1
+                self._emit_out(item)
+        else:
+            def exit_(item):
+                if seg.faults is not None:
+                    seg.faults.before_put()
+                if seg.stats is not None:
+                    seg.stats.outputs_sent += 1
+                try:
+                    entry_next(item, 0)
+                except Exception as e:
+                    # escaping the downstream guard means its policy is
+                    # 'fail': carry it past the UPSTREAM guards (whose
+                    # policies must not apply to a downstream failure)
+                    raise _FusedDownstreamError(e) from e
+        return exit_
+
+    def _make_entry(self, seg: FusedSegment, exit_):
+        svc = seg.logic.svc
+
+        def entry(item, cid):
+            if isinstance(item, SynthChunk) and not seg.accepts_chunks:
+                item = item.materialize(self.pool)  # plane boundary
+            seg.taken += 1
+            if seg.faults is not None:
+                # outside the policy guard: an injected crash is a
+                # replica death, never a skippable tuple failure
+                seg.faults.on_tuple(seg.taken)
+            st = seg.stats
+            if st is not None:
+                st.inputs_received += 1
+            try:
+                svc(item, cid, exit_)
+            except Exception as e:
+                if seg.policy == POLICY_FAIL:
+                    raise
+                if st is not None:
+                    st.svc_failures += 1
+                if seg.policy == POLICY_DEAD_LETTER \
+                        and seg.dead_letters is not None:
+                    seg.dead_letters.add(seg.name, item, e)
+        return entry
+
+    # -- NodeLogic surface ----------------------------------------------
+    def svc_init(self):
+        for seg in self.segments:
+            # device logics write launch metrics into their own record
+            seg.logic.stats = seg.stats
+            seg.logic.svc_init()
+
+    def svc(self, item, channel_id, emit):
+        self._emit_out = emit
+        try:
+            st0 = self.segments[0].stats
+            if st0 is not None:
+                self._obs_left -= 1
+                if self._obs_left <= 0:
+                    t0 = _time.perf_counter()
+                    self._entry0(item, channel_id)
+                    st0.observe((_time.perf_counter() - t0) * 1e6)
+                    self._obs_left = 1 if st0.samples < 64 else 16
+                    return
+            self._entry0(item, channel_id)
+        except _FusedDownstreamError as w:
+            raise w.error
+
+    def eos_flush(self, emit):
+        self._emit_out = emit
+        try:
+            for k, seg in enumerate(self.segments):
+                seg.logic.eos_flush(self._exits[k])
+        except _FusedDownstreamError as w:
+            raise w.error
+
+    def svc_end(self):
+        first_err = None
+        for seg in self.segments:
+            try:
+                seg.logic.svc_end()
+            except BaseException as e:  # run every teardown hook
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+
+    def set_segments_terminated(self):
+        """Clean-EOS hook (RtNode.run): mark every segment's record."""
+        for seg in self.segments:
+            if seg.stats is not None:
+                seg.stats.set_terminated()
+
+    def _idle_tick(self, emit):
+        self._emit_out = emit
+        try:
+            for k, seg in enumerate(self.segments):
+                tick = getattr(seg.logic, "idle_tick", None)
+                if tick is not None:
+                    tick(self._exits[k])
+        except _FusedDownstreamError as w:
+            raise w.error
+
+    def quiesce(self, emit) -> bool:
+        """Live-barrier hook: drain every segment's in-flight device
+        work; emissions feed the downstream segments inline."""
+        self._emit_out = emit
+        emitted = False
+        try:
+            for k, seg in enumerate(self.segments):
+                q = getattr(seg.logic, "quiesce", None)
+                if q is not None:
+                    emitted = bool(q(self._exits[k])) or emitted
+        except _FusedDownstreamError as w:
+            raise w.error
+        return emitted
+
+    # -- checkpoint: per-segment, keyed by original node name ----------
+    def state_dict(self):
+        states = {}
+        for seg in self.segments:
+            getter = getattr(seg.logic, "state_dict", None)
+            st = getter() if getter is not None else None
+            if st is not None:
+                states[seg.name] = st
+        return {"fused": states} if states else None
+
+    def load_state(self, state):
+        states = state.get("fused", state)
+        for seg in self.segments:
+            if seg.name in states:
+                seg.logic.load_state(states[seg.name])
+
+
+def source_loop_of(logic) -> Optional["SourceLoopLogic"]:
+    """The SourceLoopLogic driving a channel-less node, seen through
+    fusion/chaining wrappers (PipeGraph.start attaches the pause gate
+    to it)."""
+    if isinstance(logic, SourceLoopLogic):
+        return logic
+    if isinstance(logic, FusedLogic):
+        return source_loop_of(logic.segments[0].logic)
+    if isinstance(logic, ChainedLogic):
+        return source_loop_of(logic.a)
+    return None
+
+
 class Outlet:
     """Output side of a node: an emitter routing items to destination
     channels.  ``dests`` is a list of (channel, producer_id)."""
@@ -150,13 +401,40 @@ class Outlet:
         ch, pid = self.dests[dest_idx]
         ch.put(pid, item)
 
+    def send_many_to(self, dest_idx: int, items) -> None:
+        """Ship a same-destination run of items as one bulk transfer
+        (one channel lock round trip instead of one per item)."""
+        ch, pid = self.dests[dest_idx]
+        pm = getattr(ch, "put_many", None)
+        if pm is not None:
+            pm(pid, items)
+        else:
+            for item in items:
+                ch.put(pid, item)
+
     def send(self, item: Any) -> None:
         if len(self.dests) > 1 and isinstance(item, SynthChunk):
             # routing emitters read key/id columns: materialize the
             # descriptor before fan-out (single-destination outlets
             # pass it through; the consuming node decides there)
-            item = item.materialize()
+            item = item.materialize(self.emitter.pool)
         self.emitter.emit(item, self.send_to)
+
+    def send_many(self, items) -> None:
+        """Batched send: route a whole emission buffer, accumulating
+        same-destination items into single transfers.  Emitters that
+        implement ``emit_many`` (StandardEmitter) group; others fall
+        back to per-item ``send``."""
+        emit_many = getattr(self.emitter, "emit_many", None)
+        if emit_many is None:
+            for item in items:
+                self.send(item)
+            return
+        if len(self.dests) > 1:
+            pool = self.emitter.pool
+            items = [it.materialize(pool) if isinstance(it, SynthChunk)
+                     else it for it in items]
+        emit_many(items, self.send_to, self.send_many_to)
 
     def flush_eos(self) -> None:
         """Let the emitter publish trailing items (e.g. WF per-key EOS
@@ -211,6 +489,9 @@ class RtNode(threading.Thread):
         self.cancelled = False  # unwound by graph cancellation, no error
         self.stats = None  # StatsRecord when tracing is enabled
         self.group = None  # complex-nesting group id (multipipe grouping)
+        # wiring marks collector nodes (ordering/K-slack/farm merge)
+        # structurally; the fusion pass must never fuse across them
+        self.is_collector = False
         # drain detection for the live-checkpoint barrier: an item is
         # in flight while taken != done
         self.taken = 0
@@ -227,6 +508,12 @@ class RtNode(threading.Thread):
         self.error_policy = POLICY_FAIL
         self.dead_letters = None
         self.faults = None
+        # per-graph ColumnPool (attached at start; None = allocate fresh)
+        self.pool = None
+        # sampled service-time observation: stride 1 for the first 64
+        # samples, then 1/16 -- tracing must not cost a perf_counter
+        # pair per tuple on the hot path
+        self._obs_left = 1
 
     def _emit(self, item: Any) -> None:
         if self.stats is not None:
@@ -246,9 +533,14 @@ class RtNode(threading.Thread):
         try:
             if stats is not None:
                 stats.inputs_received += 1
-                t0 = _time.perf_counter()
-                self.logic.svc(item, cid, self._emit)
-                stats.observe((_time.perf_counter() - t0) * 1e6)
+                self._obs_left -= 1
+                if self._obs_left <= 0:
+                    t0 = _time.perf_counter()
+                    self.logic.svc(item, cid, self._emit)
+                    stats.observe((_time.perf_counter() - t0) * 1e6)
+                    self._obs_left = 1 if stats.samples < 64 else 16
+                else:
+                    self.logic.svc(item, cid, self._emit)
             else:
                 self.logic.svc(item, cid, self._emit)
         except Exception as e:
@@ -260,6 +552,65 @@ class RtNode(threading.Thread):
                     and self.dead_letters is not None:
                 self.dead_letters.add(self.name, item, e)
 
+    def _flush_emits(self, buf) -> None:
+        """Deliver a buffered emission run as grouped bulk channel
+        transfers.  Under a bound FaultPlan, fall back to the per-item
+        path: a put-targeted fault must interleave its clock with the
+        actual deliveries (crash at tick k delivers exactly the k-1
+        item prefix, as at LEVEL0) -- batching the ticks ahead of the
+        sends would lose the whole batch instead."""
+        if self.faults is not None:
+            for item in buf:
+                self._emit(item)
+            return
+        if self.stats is not None:
+            self.stats.outputs_sent += len(buf)
+        for o in self.outlets:
+            o.send_many(buf)
+
+    def _svc_batch(self, got, accepts_chunks: bool, faults, pool) -> None:
+        """Process one get_many batch with buffered emissions: outputs
+        accumulate in a list and leave in grouped bulk puts afterwards
+        (only for logics whose ``sync_emit`` contract holds).  Error
+        policies, fault clocks and drain accounting match the per-item
+        loop; ``done`` advances only after the flush so the quiesce
+        barrier never sees buffered emissions as drained."""
+        buf: list = []
+        append = buf.append
+        stats = self.stats
+        svc = self.logic.svc
+        processed = 0
+        t0 = _time.perf_counter() if stats is not None else 0.0
+        try:
+            for cid, item in got:
+                if not accepts_chunks and isinstance(item, SynthChunk):
+                    item = item.materialize(pool)  # plane boundary
+                self.taken += 1
+                processed += 1
+                if faults is not None:
+                    faults.on_tuple(self.taken)  # may raise
+                if stats is not None:
+                    stats.inputs_received += 1
+                try:
+                    svc(item, cid, append)
+                except Exception as e:
+                    if self.error_policy == POLICY_FAIL:
+                        raise
+                    if stats is not None:
+                        stats.svc_failures += 1
+                    if self.error_policy == POLICY_DEAD_LETTER \
+                            and self.dead_letters is not None:
+                        self.dead_letters.add(self.name, item, e)
+        finally:
+            try:
+                if buf:
+                    self._flush_emits(buf)
+            finally:
+                self.done += processed
+        if stats is not None and processed:
+            # one amortized observation per batch, not per tuple
+            stats.observe((_time.perf_counter() - t0) * 1e6 / processed)
+
     def _consume_loop(self) -> None:
         # logics with an idle_tick hook (time-bounded device launches on
         # stalled streams) take timed gets so the tick fires without input
@@ -267,8 +618,20 @@ class RtNode(threading.Thread):
         accepts_chunks = getattr(self.logic, "accepts_synth_chunks", False)
         faults = self.faults
         channel = self.channel
+        pool = self.pool
+        get_many = getattr(channel, "get_many", None)
+        # buffered emissions require the logic's emits to happen inside
+        # the svc call (sync_emit); the async window engines opt out
+        buffered = get_many is not None \
+            and getattr(self.logic, "sync_emit", True)
+        timeout = 0.025 if tick else None
         while True:
-            got = (channel.get(timeout=0.025) if tick else channel.get())
+            if get_many is not None:
+                got = get_many(GET_MANY_MAX, timeout)
+            else:  # duck-typed channel without the bulk surface
+                got = channel.get(timeout) if tick else channel.get()
+                if isinstance(got, tuple):
+                    got = [got]
             if got is CHANNEL_TIMEOUT:
                 if not (self.pause_ctl is not None
                         and self.pause_ctl.pausing):
@@ -276,19 +639,22 @@ class RtNode(threading.Thread):
                 continue
             if got is None:
                 break
-            cid, item = got
-            if not accepts_chunks and isinstance(item, SynthChunk):
-                item = item.materialize()  # plane boundary
-            self.taken += 1
-            if faults is not None:
-                faults.on_tuple(self.taken)  # may raise InjectedFailure
-            try:
-                self._svc_guarded(item, cid)
-            finally:
-                # count failed tuples as done too: the quiesce barrier's
-                # in-flight detection must not see a skipped tuple as
-                # forever in flight
-                self.done += 1
+            if buffered and len(got) > 1:
+                self._svc_batch(got, accepts_chunks, faults, pool)
+                continue
+            for cid, item in got:
+                if not accepts_chunks and isinstance(item, SynthChunk):
+                    item = item.materialize(pool)  # plane boundary
+                self.taken += 1
+                if faults is not None:
+                    faults.on_tuple(self.taken)  # may raise InjectedFailure
+                try:
+                    self._svc_guarded(item, cid)
+                finally:
+                    # count failed tuples as done too: the quiesce
+                    # barrier's in-flight detection must not see a
+                    # skipped tuple as forever in flight
+                    self.done += 1
 
     def run(self) -> None:
         try:
@@ -301,6 +667,9 @@ class RtNode(threading.Thread):
             self.logic.eos_flush(self._emit)
             if self.stats is not None:
                 self.stats.set_terminated()
+            term = getattr(self.logic, "set_segments_terminated", None)
+            if term is not None:  # fused node: per-segment records
+                term()
         except GraphCancelled:
             self.cancelled = True  # clean unwind, not a failure
         except BaseException as e:  # surfaced by PipeGraph.wait_end

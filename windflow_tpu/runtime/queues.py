@@ -32,6 +32,14 @@ _EOS_SENTINEL = object()
 # None (which means every producer closed)
 CHANNEL_TIMEOUT = object()
 
+# bounded spin before an empty get() blocks on the condition variable:
+# each iteration yields the GIL, so a producer mid-put gets a chance to
+# publish without this consumer paying a full cv sleep/wake round trip
+GET_SPIN = 24
+
+# default batch a bulk consumer pops per lock round trip
+GET_MANY_MAX = 128
+
 
 class Channel:
     """Bounded multi-producer single-consumer channel.
@@ -45,7 +53,7 @@ class Channel:
 
     __slots__ = ("_items", "_lock", "_not_empty", "_not_full",
                  "n_producers", "_eos_seen", "capacity", "poisoned",
-                 "puts", "gets", "high_watermark")
+                 "puts", "gets", "high_watermark", "_all_closed")
 
     def __init__(self, capacity: int = DEFAULT_QUEUE_CAPACITY):
         self._items: deque = deque()
@@ -63,6 +71,7 @@ class Channel:
         self.puts = 0
         self.gets = 0
         self.high_watermark = 0
+        self._all_closed = False  # sticky once every producer closed
 
     def register_producer(self) -> int:
         with self._lock:
@@ -86,6 +95,38 @@ class Channel:
                 self.high_watermark = d
             self._not_empty.notify()
 
+    def put_many(self, producer_id: int, items) -> None:
+        """Bulk put: one lock round trip per capacity window instead of
+        one per item.  Equivalent to ``for it in items: put(pid, it)``
+        including backpressure (never overfills the bound) and poison
+        semantics (raises as soon as the channel is cancelled; items
+        already appended stay appended, exactly like the loop)."""
+        n = len(items)
+        if n == 0:
+            return
+        i = 0
+        with self._not_full:
+            while i < n:
+                while self.capacity is not None \
+                        and len(self._items) >= self.capacity \
+                        and not self.poisoned:
+                    self._not_full.wait()
+                if self.poisoned:
+                    raise GraphCancelled(f"channel poisoned (producer "
+                                         f"{producer_id})")
+                room = (n - i if self.capacity is None
+                        else self.capacity - len(self._items))
+                take = min(room, n - i)
+                append = self._items.append
+                for j in range(i, i + take):
+                    append((producer_id, items[j]))
+                i += take
+                self.puts += take
+                d = len(self._items)
+                if d > self.high_watermark:
+                    self.high_watermark = d
+                self._not_empty.notify()
+
     def close(self, producer_id: int) -> None:
         # EOS bypasses the capacity bound (like the native channel): a
         # producer must always be able to announce its end of stream
@@ -95,11 +136,25 @@ class Channel:
             self._items.append((producer_id, _EOS_SENTINEL))
             self._not_empty.notify()
 
+    def _spin(self) -> None:
+        """Bounded spin before blocking: each sleep(0) yields the GIL so
+        a producer mid-put can publish, saving the cv round trip on
+        busy channels.  Purely an optimization -- falling through to
+        the condition wait is always correct."""
+        for _ in range(GET_SPIN):
+            if self._items or self.poisoned:
+                return
+            _time.sleep(0)
+
     def get(self, timeout: Optional[float] = None):
         """Next (channel_id, item); None when all producers closed;
         CHANNEL_TIMEOUT when ``timeout`` seconds pass with nothing to
         deliver (idle-tick consumers).  Raises GraphCancelled once the
         channel is poisoned."""
+        if timeout is None and not self._items and not self._all_closed:
+            # spin only for indefinite gets: timed gets are idle-tick
+            # pollers where the cv wait IS the intended pacing
+            self._spin()
         with self._not_empty:
             deadline = (None if timeout is None
                         else _time.monotonic() + timeout)
@@ -107,6 +162,8 @@ class Channel:
                 while not self._items:
                     if self.poisoned:
                         raise GraphCancelled("channel poisoned")
+                    if self._all_closed:
+                        return None
                     if deadline is None:
                         self._not_empty.wait()
                     else:
@@ -121,10 +178,58 @@ class Channel:
                 if item is _EOS_SENTINEL:
                     self._eos_seen += 1
                     if self._eos_seen >= self.n_producers:
+                        self._all_closed = True
                         return None
                     continue
                 self.gets += 1
                 return pid, item
+
+    def get_many(self, max_n: int = GET_MANY_MAX,
+                 timeout: Optional[float] = None):
+        """Pop up to ``max_n`` items under one lock round trip.
+
+        Returns a non-empty list of ``(channel_id, item)`` pairs in
+        arrival order, ``None`` once every producer has closed (sticky),
+        or ``CHANNEL_TIMEOUT``.  Blocks until at least one item is
+        available, like ``get``."""
+        out = []
+        if timeout is None and not self._items and not self._all_closed:
+            self._spin()
+        with self._not_empty:
+            deadline = (None if timeout is None
+                        else _time.monotonic() + timeout)
+            while True:
+                while not self._items:
+                    if self.poisoned:
+                        raise GraphCancelled("channel poisoned")
+                    if self._all_closed:
+                        return None
+                    if deadline is None:
+                        self._not_empty.wait()
+                    else:
+                        remaining = deadline - _time.monotonic()
+                        if remaining <= 0:
+                            return CHANNEL_TIMEOUT
+                        self._not_empty.wait(remaining)
+                if self.poisoned:
+                    raise GraphCancelled("channel poisoned")
+                popleft = self._items.popleft
+                while self._items and len(out) < max_n:
+                    pid, item = popleft()
+                    if item is _EOS_SENTINEL:
+                        self._eos_seen += 1
+                        if self._eos_seen >= self.n_producers:
+                            self._all_closed = True
+                            break
+                        continue
+                    out.append((pid, item))
+                self._not_full.notify_all()
+                if out:
+                    self.gets += len(out)
+                    return out
+                if self._all_closed:
+                    return None
+                # only partial EOS tokens were drained: wait for data
 
     def poison(self) -> None:
         """Graph-cancellation sentinel: wake and fail all blocked ends."""

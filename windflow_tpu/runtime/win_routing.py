@@ -174,7 +174,7 @@ class KFEmitter(Emitter):
                     (self.routing(int(k) if k >= 0 else -int(k),
                                   self.pardegree) for k in item.key),
                     np.int64, len(item.key))
-            for d, sub in partition_batch(item, dests):
+            for d, sub in partition_batch(item, dests, self.pool):
                 send_to(d, sub)
             return
         rec = item.record if isinstance(item, EOSMarker) else item

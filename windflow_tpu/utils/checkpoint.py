@@ -29,10 +29,13 @@ def _is_stateful(logic) -> bool:
     overrides NodeLogic.state_dict (so the saved twin produced state).
     Avoids calling state_dict(), which serializes the full store just
     to test for None.  ChainedLogic defers to its halves (its own
-    override returns None when both are stateless)."""
-    from ..runtime.node import ChainedLogic, NodeLogic
+    override returns None when both are stateless); FusedLogic to its
+    segments."""
+    from ..runtime.node import ChainedLogic, FusedLogic, NodeLogic
     if isinstance(logic, ChainedLogic):
         return _is_stateful(logic.a) or _is_stateful(logic.b)
+    if isinstance(logic, FusedLogic):
+        return any(_is_stateful(s.logic) for s in logic.segments)
     fn = getattr(type(logic), "state_dict", None)
     if fn is None:  # duck-typed logic: the instance hook decides
         return getattr(logic, "state_dict", None) is not None
@@ -40,16 +43,20 @@ def _is_stateful(logic) -> bool:
 
 
 def graph_state(graph) -> Dict[str, Any]:
-    """Collect every replica's state_dict, keyed by node name."""
+    """Collect every replica's state_dict, keyed by (pre-fusion) node
+    name.  Nodes the LEVEL2 compile pass fused (graph/fuse.py) are
+    flattened back to their segments via ``iter_logics``, so snapshot
+    keys are FUSION-INVARIANT: a LEVEL0 snapshot restores into a LEVEL2
+    graph (started or not) and vice versa."""
+    from ..graph.fuse import iter_logics
     out = {}
-    for node in graph._all_nodes():
-        logic = node.logic
+    for name, logic in iter_logics(graph):
         getter = getattr(logic, "state_dict", None)
         if getter is None:
             continue
         st = getter()
         if st is not None:
-            out[node.name] = st
+            out[name] = st
     return out
 
 
@@ -69,12 +76,13 @@ def restore_graph(graph, path: str) -> int:
     or vice versa).  Which nodes are stateful is determined by the
     graph structure, not by stream data, so set equality is the
     structure check."""
+    from ..graph.fuse import iter_logics
     with open(path, "rb") as f:
         states = pickle.load(f)
     loadable = {}
-    for node in graph._all_nodes():
-        if _is_stateful(node.logic):
-            loadable[node.name] = node.logic
+    for name, logic in iter_logics(graph):
+        if _is_stateful(logic):
+            loadable[name] = logic
     extra = set(states) - set(loadable)
     missing = set(loadable) - set(states)
     if extra or missing:
