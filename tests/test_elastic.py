@@ -1,0 +1,548 @@
+"""Elastic scaling plane tests (windflow_tpu/elastic/; docs/ELASTIC.md).
+
+Key repartitioning properties (deterministic, total, state-conserving),
+the pause-drain-migrate protocol end to end (manual 1->4->1 under load
+with zero lost/duplicated tuples and results equal to a fixed-
+parallelism run), credited-ingest rewiring, load-driven controller
+scale-up, fault injection around a rescale, and the monitoring
+surface (gauges + rescale events in the stats JSON).
+"""
+import json
+import random
+import threading
+import time
+
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core import BasicRecord, Mode
+from windflow_tpu.elastic import (ElasticityConfig, merge_keyed_states,
+                                  owner_of, partition_keyed_state)
+from windflow_tpu.elastic.controller import decide
+from windflow_tpu.elastic.signals import LoadReport
+from windflow_tpu.core.basic import ElasticSpec
+from windflow_tpu.runtime.queues import Channel
+
+
+# ---------------------------------------------------------------------------
+# key repartitioning properties
+# ---------------------------------------------------------------------------
+
+def _random_keys(rng, n):
+    keys = [rng.randrange(1 << 31) for _ in range(n // 2)]
+    keys += [f"user-{rng.randrange(10_000)}" for _ in range(n - len(keys))]
+    return keys
+
+
+def test_owner_deterministic_and_total():
+    rng = random.Random(7)
+    keys = _random_keys(rng, 200)
+    for n in (1, 2, 3, 4, 7):
+        owners = {k: owner_of(k, n) for k in keys}
+        # total: every key owned by exactly one replica, in range
+        assert all(0 <= d < n for d in owners.values())
+        # deterministic: recomputation agrees
+        assert owners == {k: owner_of(k, n) for k in keys}
+
+
+def test_owner_matches_emitter_routing():
+    """Rescale ownership MUST equal where the KEYBY emitter routes,
+    for both the record path (default_hash % n) and the int64 batch
+    path (abs(key) % n)."""
+    from windflow_tpu.core.meta import default_hash
+    rng = random.Random(3)
+    for n in (2, 3, 5):
+        for k in [rng.randrange(1 << 31) for _ in range(50)]:
+            assert owner_of(k, n) == default_hash(k) % n
+            assert owner_of(k, n) == abs(k) % n  # batch-path contract
+        for k in [f"k{rng.randrange(999)}" for _ in range(50)]:
+            assert owner_of(k, n) == default_hash(k) % n
+
+
+def test_partition_state_conserving():
+    rng = random.Random(11)
+    merged = {k: [k, rng.random()] for k in _random_keys(rng, 300)}
+    for n_from, n_to in ((1, 4), (4, 1), (3, 5), (5, 2)):
+        parts = partition_keyed_state(dict(merged), n_to)
+        assert len(parts) == n_to
+        # disjoint and union-exact: merged per-key state before == after
+        seen = {}
+        for i, part in enumerate(parts):
+            for k, v in part.items():
+                assert k not in seen
+                assert owner_of(k, n_to) == i
+                seen[k] = v
+        assert seen == merged
+
+
+def test_merge_detects_duplicate_keys():
+    class FakeLogic:
+        def __init__(self, st):
+            self._st = st
+
+        def keyed_state_dict(self):
+            return self._st
+
+    class FakeNode:
+        name = "op.0"
+
+        def __init__(self, st):
+            self.logic = FakeLogic(st)
+
+    merged, stateful = merge_keyed_states(
+        [FakeNode({1: "a"}), FakeNode({2: "b"})])
+    assert stateful and merged == {1: "a", 2: "b"}
+    from windflow_tpu.elastic import RescaleError
+    with pytest.raises(RescaleError, match="invariant"):
+        merge_keyed_states([FakeNode({1: "a"}), FakeNode({1: "b"})])
+
+
+def test_channel_depth_gauge():
+    ch = Channel(capacity=8)
+    pid = ch.register_producer()
+    assert ch.depth == 0
+    ch.put(pid, "x")
+    ch.put(pid, "y")
+    assert ch.depth == 2
+    ch.get()
+    assert ch.depth == 1
+
+
+def test_decide_hysteresis_band():
+    spec = ElasticSpec(1, 8, target_util=0.75)
+    cfg = ElasticityConfig()
+
+    def rep(util, n=2, depth_frac=0.0, credit=0.0):
+        return LoadReport("op", n, util, int(depth_frac * 100),
+                          depth_frac, credit, 1000.0, 0.0)
+
+    assert decide(rep(0.75), spec, cfg) is None           # inside band
+    assert decide(rep(0.80), spec, cfg) is None           # still inside
+    up = decide(rep(1.5), spec, cfg)
+    assert up is not None and up[0] == 4                  # proportional
+    assert decide(rep(0.2, depth_frac=0.9), spec, cfg)[0] >= 3  # backlog
+    down = decide(rep(0.2), spec, cfg)
+    assert down is not None and down[0] == 1
+    # never outside [min, max]
+    assert decide(rep(4.0, n=8), ElasticSpec(1, 8), cfg) is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end rescale under load
+# ---------------------------------------------------------------------------
+
+def _paced_source(records, state, pace_every=64, pace_s=0.001):
+    def fn(shipper, ctx):
+        i = state["i"]
+        if i >= len(records):
+            return False
+        if pace_every and i % pace_every == 0:
+            time.sleep(pace_s)
+        k, v = records[i]
+        shipper.push(BasicRecord(k, i, i, v))
+        state["i"] = i + 1
+        return True
+    return fn
+
+
+class _Collect:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.items = []
+
+    def __call__(self, r):
+        if r is not None:
+            with self.lock:
+                self.items.append((r.key, r.value))
+
+    def per_key(self):
+        out = {}
+        for k, v in self.items:
+            out.setdefault(k, []).append(v)
+        return out
+
+
+def _fold(t, acc):
+    acc.value += t.value
+
+
+def _build_acc_graph(records, state, elastic, config=None):
+    got = _Collect()
+    g = wf.PipeGraph("elastic", Mode.DEFAULT,
+                     config=config or wf.RuntimeConfig(
+                         elasticity=ElasticityConfig(enabled=False)))
+    b = wf.AccumulatorBuilder(_fold).with_name("acc") \
+        .with_initial_value(BasicRecord())
+    if elastic:
+        b = b.with_elasticity(1, 4)
+    g.add_source(wf.SourceBuilder(_paced_source(records, state)).build()) \
+        .add(b.build()).add_sink(wf.SinkBuilder(got).build())
+    return g, got
+
+
+def _wait_progress(state, upto, deadline_s=30.0):
+    deadline = time.monotonic() + deadline_s
+    while state["i"] < upto:
+        assert time.monotonic() < deadline, "source made no progress"
+        time.sleep(0.002)
+
+
+def test_scripted_rescale_1_4_1_conserves_and_matches_fixed():
+    """The acceptance scenario: an elastic keyed operator scales
+    1->4->1 mid-stream with zero lost or duplicated tuples, per-key
+    output sequences identical to a fixed-parallelism run, and the
+    rescale events visible in the stats JSON."""
+    n_keys, n = 8, 6000
+    records = [(i % n_keys, 1.0) for i in range(n)]
+
+    # fixed-parallelism reference run
+    ref_state = {"i": 0}
+    g_ref, ref = _build_acc_graph(records, ref_state, elastic=False)
+    g_ref.run()
+    assert len(ref.items) == n
+
+    state = {"i": 0}
+    g, got = _build_acc_graph(records, state, elastic=True)
+    g.start()
+    _wait_progress(state, n // 3)
+    ev1 = g.rescale("acc", 4, trigger="scripted step")
+    _wait_progress(state, 2 * n // 3)
+    ev2 = g.rescale("acc", 1, trigger="scripted step")
+    g.wait_end()
+
+    assert (ev1.old_parallelism, ev1.new_parallelism) == (1, 4)
+    assert (ev2.old_parallelism, ev2.new_parallelism) == (4, 1)
+    # conservation: exactly one output per input, none lost or duplicated
+    assert len(got.items) == n
+    # per-key output sequences equal the fixed run's (keyed routing
+    # keeps each key on one replica at a time; the drain barrier keeps
+    # per-key order across the migration)
+    assert got.per_key() == ref.per_key()
+    rep = json.loads(g.stats.to_json())
+    assert rep["Rescales"] == 2
+    evs = rep["Rescale_events"]
+    assert [(e["old_parallelism"], e["new_parallelism"]) for e in evs] \
+        == [(1, 4), (4, 1)]
+    assert all(e["operator"] == "pipe0/acc" and e["at"] > 0
+               and "scripted" in e["trigger"] for e in evs)
+    acc_op = next(o for o in rep["Operators"]
+                  if o["Operator_name"] == "pipe0/acc")
+    assert acc_op["Parallelism"] == 1          # live override post-shrink
+    assert len(acc_op["Replicas"]) == 4        # history retained
+
+
+def test_rescale_updates_kept_replica_context():
+    """Kept replicas must see the new parallelism in their
+    RuntimeContext after a rescale: a rich fn(t, ctx) may read
+    ctx.parallelism for per-replica sharding, and a stale count would
+    disagree with where the emitter now routes."""
+    n = 6000
+    records = [(i % 8, 1.0) for i in range(n)]
+    state = {"i": 0}
+    g, _got = _build_acc_graph(records, state, elastic=True)
+    g.start()
+    handle = g.elastic["pipe0/acc"]
+    _wait_progress(state, n // 3)
+    g.rescale("acc", 3)
+    assert [r.logic.context.parallelism for r in handle.replicas] \
+        == [3, 3, 3]
+    _wait_progress(state, 2 * n // 3)
+    g.rescale("acc", 2)
+    assert [r.logic.context.parallelism for r in handle.replicas] \
+        == [2, 2]
+    g.wait_end()
+
+
+def test_scale_down_retires_replica_threads():
+    n = 4000
+    records = [(i % 5, 1.0) for i in range(n)]
+    state = {"i": 0}
+    g, got = _build_acc_graph(records, state, elastic=True)
+    g.start()
+    _wait_progress(state, n // 4)
+    g.rescale("acc", 4)
+    handle = g.elastic["pipe0/acc"]
+    grown = list(handle.replicas)
+    assert len(grown) == 4 and all(nd.is_alive() for nd in grown)
+    _wait_progress(state, n // 2)
+    g.rescale("acc", 2)
+    assert len(handle.replicas) == 2
+    retired = [nd for nd in grown if nd not in handle.replicas]
+    assert len(retired) == 2
+    for nd in retired:
+        nd.join(timeout=10.0)
+        assert not nd.is_alive() and nd.error is None
+    assert all(nd not in handle.pipe.nodes for nd in retired)
+    g.wait_end()
+    assert len(got.items) == n
+
+
+def test_stateless_keyed_map_rescale():
+    n = 5000
+    state = {"i": 0}
+    got = _Collect()
+    g = wf.PipeGraph("elastic_map", Mode.DEFAULT,
+                     config=wf.RuntimeConfig(
+                         elasticity=ElasticityConfig(enabled=False)))
+    records = [(i % 7, float(i)) for i in range(n)]
+
+    def double(t):
+        t.value *= 2
+
+    m = wf.MapBuilder(double).with_name("dbl").with_key_by() \
+        .with_elasticity(1, 3).build()
+    g.add_source(wf.SourceBuilder(_paced_source(records, state)).build()) \
+        .add(m).add_sink(wf.SinkBuilder(got).build())
+    g.start()
+    _wait_progress(state, n // 3)
+    g.rescale("dbl", 3)
+    _wait_progress(state, 2 * n // 3)
+    g.rescale("dbl", 1)
+    g.wait_end()
+    assert len(got.items) == n
+    assert sorted(v for _, v in got.items) == \
+        sorted(2.0 * v for _, v in records)
+
+
+def test_rescale_rewires_credit_proxies():
+    """An elastic operator fed by a credited ingest source: new replica
+    channels must be CreditedChannel proxies bound to the source's
+    gate, and the stream must still conserve every tuple."""
+    import numpy as np
+    from windflow_tpu.core.tuples import TupleBatch
+    from windflow_tpu.ingest.credits import CreditedChannel
+
+    n = 30000
+    trace = {"key": (np.arange(n) % 16).astype(np.int64),
+             "id": np.arange(n, dtype=np.int64),
+             "ts": np.arange(n, dtype=np.int64) * 40,
+             "value": np.ones(n)}
+    got = {"n": 0}
+    lock = threading.Lock()
+
+    def sink(r):
+        if r is None:
+            return
+        with lock:
+            got["n"] += len(r) if isinstance(r, TupleBatch) else 1
+
+    def work(t):
+        time.sleep(0.0002)
+        return t
+
+    g = wf.PipeGraph("elastic_ingest", Mode.DEFAULT,
+                     config=wf.RuntimeConfig(
+                         elasticity=ElasticityConfig(enabled=False)))
+    m = wf.MapBuilder(work).with_name("work").with_key_by() \
+        .with_elasticity(1, 4).build()
+    src = wf.SourceBuilder.from_replay(trace, speedup=1.0, chunk=256) \
+        .with_credits(4096).build()
+    g.add_source(src).add(m).add_sink(wf.SinkBuilder(sink).build())
+    g.start()
+    time.sleep(0.3)
+    g.rescale("work", 3)
+    handle = g.elastic["pipe0/work"]
+    assert len(handle.replicas) == 3
+    for nd in handle.replicas:
+        assert isinstance(nd.channel, CreditedChannel)
+        assert nd.channel.gates  # bound to the source replica's gate
+    time.sleep(0.3)
+    g.rescale("work", 1)
+    g.wait_end()
+    assert got["n"] == n
+
+
+def test_controller_scales_up_under_load():
+    """Step load against a deliberately slow keyed fold: the controller
+    must add replicas (utilization/backlog trigger) and results must
+    stay exact."""
+    n_keys, n = 16, 3000
+    records = [(i % n_keys, 1.0) for i in range(n)]
+    state = {"i": 0}
+
+    def slow_fold(t, acc):
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 0.001:
+            pass
+        acc.value += t.value
+
+    got = _Collect()
+    cfg = wf.RuntimeConfig(elasticity=ElasticityConfig(
+        sample_period_s=0.1, cooldown_s=0.4, ewma_alpha=0.6))
+    g = wf.PipeGraph("elastic_auto", Mode.DEFAULT, config=cfg)
+    acc = wf.AccumulatorBuilder(slow_fold).with_name("acc") \
+        .with_initial_value(BasicRecord()) \
+        .with_elasticity(1, 4, target_util=0.7).build()
+    g.add_source(wf.SourceBuilder(
+        _paced_source(records, state, pace_every=0)).build()) \
+        .add(acc).add_sink(wf.SinkBuilder(got).build())
+    g.run()
+    rep = json.loads(g.stats.to_json())
+    assert any(e["new_parallelism"] > e["old_parallelism"]
+               for e in rep["Rescale_events"]), \
+        f"controller never scaled up: {rep['Rescale_events']}"
+    assert len(got.items) == n
+    from collections import Counter
+    counts = Counter(k for k, _ in records)
+    finals = {k: max(vs) for k, vs in got.per_key().items()}
+    assert finals == {k: float(c) for k, c in counts.items()}
+
+
+def test_faultplan_crash_in_rescaled_replica():
+    """A FaultPlan crash targeting a replica that only EXISTS after the
+    rescale (acc.2) fires inside the rescale epoch: the graph must
+    contain the failure (no deadlock) and surface it from wait_end."""
+    from windflow_tpu.resilience import InjectedFailure
+
+    n = 6000
+    records = [(i % 8, 1.0) for i in range(n)]
+    state = {"i": 0}
+    plan = wf.FaultPlan(seed=3).crash_replica("acc.2", at_tuple=40)
+    got = _Collect()
+    g = wf.PipeGraph("elastic_crash", Mode.DEFAULT,
+                     config=wf.RuntimeConfig(
+                         fault_plan=plan,
+                         elasticity=ElasticityConfig(enabled=False)))
+    acc = wf.AccumulatorBuilder(_fold).with_name("acc") \
+        .with_initial_value(BasicRecord()).with_elasticity(1, 4).build()
+    g.add_source(wf.SourceBuilder(_paced_source(records, state)).build()) \
+        .add(acc).add_sink(wf.SinkBuilder(got).build())
+    g.start()
+    _wait_progress(state, n // 4)
+    g.rescale("acc", 4)   # creates acc.2, arming its crash clock
+    t0 = time.monotonic()
+    with pytest.raises(wf.NodeFailureError) as ei:
+        g.wait_end()
+    assert time.monotonic() - t0 < 60.0
+    assert any(isinstance(err, InjectedFailure)
+               for _, err in ei.value.errors)
+    # a rescale attempt on the failed graph refuses cleanly
+    with pytest.raises((RuntimeError, KeyError)):
+        g.rescale("acc", 2)
+
+
+# ---------------------------------------------------------------------------
+# validation + API errors
+# ---------------------------------------------------------------------------
+
+def test_with_elasticity_validation():
+    with pytest.raises(ValueError):
+        wf.MapBuilder(lambda t: t).with_elasticity(0, 4)
+    with pytest.raises(ValueError):
+        wf.MapBuilder(lambda t: t).with_elasticity(4, 2)
+    with pytest.raises(ValueError):
+        wf.MapBuilder(lambda t: t).with_elasticity(1, 4, target_util=1.5)
+    with pytest.raises(ValueError, match="not elastically scalable"):
+        wf.SourceBuilder(lambda s: False).with_elasticity(1, 4)
+    # starting parallelism rises to the declared minimum
+    op = wf.MapBuilder(lambda t: t).with_key_by() \
+        .with_elasticity(2, 4).build()
+    assert op.parallelism == 2
+    # ... but an explicit parallelism above the maximum is a
+    # contradictory declaration, not something to clamp silently
+    with pytest.raises(ValueError, match="exceeds"):
+        wf.MapBuilder(lambda t: t).with_key_by() \
+            .with_parallelism(8).with_elasticity(1, 4).build()
+
+
+def test_elastic_rejects_unsupported_shapes():
+    def src(shipper, ctx):
+        return False
+
+    # window operators have no elastic factory
+    g = wf.PipeGraph("bad1", Mode.DEFAULT)
+    mp = g.add_source(wf.SourceBuilder(src).build())
+    win = wf.KeyFarmBuilder(lambda g_, it, r: None) \
+        .with_cb_windows(4, 2).with_elasticity(1, 4).build()
+    with pytest.raises(ValueError, match="cannot be elastic"):
+        mp.add(win)
+
+    # non-DEFAULT modes keep per-channel ordering collectors
+    g2 = wf.PipeGraph("bad2", Mode.DETERMINISTIC)
+    mp2 = g2.add_source(wf.SourceBuilder(src).build())
+    m = wf.MapBuilder(lambda t: t).with_key_by() \
+        .with_elasticity(1, 4).build()
+    with pytest.raises(ValueError, match="Mode.DEFAULT"):
+        mp2.add(m)
+
+
+def test_rescale_api_errors():
+    n = 2000
+    records = [(i % 4, 1.0) for i in range(n)]
+    state = {"i": 0}
+    g, got = _build_acc_graph(records, state, elastic=True)
+    with pytest.raises(RuntimeError, match="started"):
+        g.rescale("acc", 2)
+    g.start()
+    with pytest.raises(KeyError):
+        g.rescale("nope", 2)
+    with pytest.raises(ValueError, match="elastic interval"):
+        g.rescale("acc", 9)
+    assert g.rescale("acc", 1) is None   # no-op at current parallelism
+    g.wait_end()
+    with pytest.raises(RuntimeError):
+        g.rescale("acc", 2)
+    assert len(got.items) == n
+
+
+def test_chain_falls_back_to_add_for_elastic():
+    """chain() must not thread-fuse an elastic operator away."""
+    n = 1000
+    records = [(i % 4, float(i)) for i in range(n)]
+    state = {"i": 0}
+    got = _Collect()
+    g = wf.PipeGraph("elastic_chain", Mode.DEFAULT,
+                     config=wf.RuntimeConfig(
+                         elasticity=ElasticityConfig(enabled=False)))
+    m = wf.MapBuilder(lambda t: t).with_name("em") \
+        .with_elasticity(1, 2).build()
+    g.add_source(wf.SourceBuilder(
+        _paced_source(records, state, pace_every=0)).build()) \
+        .chain(m).chain_sink(wf.SinkBuilder(got).build())
+    assert "pipe0/em" in g.elastic
+    g.run()
+    assert len(got.items) == n
+
+
+def test_fusion_pass_skips_elastic_nodes():
+    """At LEVEL2 the compile pass must leave elastic replicas as their
+    own threads (rescale rebuilds them), while still fusing the rest of
+    the chain."""
+    n = 2000
+    records = [(i % 4, 1.0) for i in range(n)]
+    state = {"i": 0}
+    g, got = _build_acc_graph(records, state, elastic=True)
+    assert g.config.opt_level == wf.OptLevel.LEVEL2
+    g.start()
+    handle = g.elastic["pipe0/acc"]
+    from windflow_tpu.runtime.node import FusedLogic
+    assert all(not isinstance(nd.logic, FusedLogic)
+               for nd in handle.replicas)
+    assert all(nd.is_alive() for nd in handle.replicas)
+    g.rescale("acc", 2)
+    g.wait_end()
+    assert len(got.items) == n
+
+
+def test_gauges_and_events_in_stats_json():
+    n = 1500
+    records = [(i % 4, 1.0) for i in range(n)]
+    state = {"i": 0}
+    g, got = _build_acc_graph(records, state, elastic=True)
+    g.start()
+    _wait_progress(state, n // 3)
+    g.rescale("acc", 2, trigger="gauge test")
+    g.refresh_gauges()
+    g.wait_end()
+    g.refresh_gauges()
+    rep = json.loads(g.stats.to_json())
+    acc_op = next(o for o in rep["Operators"]
+                  if o["Operator_name"] == "pipe0/acc")
+    for r in acc_op["Replicas"]:
+        assert "Queue_depth" in r and "Credit_wait_s" in r
+    assert rep["Rescales"] == 1
+    e = rep["Rescale_events"][0]
+    assert set(e) >= {"at", "operator", "old_parallelism",
+                      "new_parallelism", "trigger", "duration_s"}
+    assert e["trigger"] == "gauge test"
+    assert len(got.items) == n

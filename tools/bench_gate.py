@@ -67,6 +67,12 @@ def measure() -> dict:
     r2, _ = bench.run_record_chain_host(50_000, opt_level=OptLevel.LEVEL2)
     out["7_record_chain_host_unfused"] = round(r0, 1)
     out["7_record_chain_host"] = round(r2, 1)
+    # elastic step-load smoke (elastic/): the rate is the paced feed,
+    # so a cliff here means rescale stalls in the hot path -- and the
+    # run must conserve every tuple across the controller's rescales
+    r2i, _lats, _evs, (sunk, sent) = bench.run_elastic_step(3_000)
+    assert sunk == sent, f"elastic step lost tuples: {sunk}/{sent}"
+    out["2i_elastic_step"] = round(r2i, 1)
     return out
 
 

@@ -18,9 +18,9 @@ windflow_gpu.hpp:34-42):
 """
 from .core import (Mode, WinType, OptLevel, RoutingMode, Pattern, WinEvent,
                    OrderingMode, Role, WinOperatorConfig, RuntimeConfig,
-                   BasicRecord, TupleBatch, EOS, TriggererCB, TriggererTB,
-                   Window, StreamArchive, FlatFAT, Iterable, Shipper,
-                   RuntimeContext, LocalStorage, Expr, F)
+                   ElasticSpec, BasicRecord, TupleBatch, EOS, TriggererCB,
+                   TriggererTB, Window, StreamArchive, FlatFAT, Iterable,
+                   Shipper, RuntimeContext, LocalStorage, Expr, F)
 
 __version__ = "0.1.0"
 
@@ -54,6 +54,12 @@ def __getattr__(name):
         "encode_batch": "windflow_tpu.ingest",
         "decode_batch": "windflow_tpu.ingest",
         "StreamDecoder": "windflow_tpu.ingest",
+        # elastic scaling plane (elastic/; docs/ELASTIC.md)
+        "ElasticityConfig": "windflow_tpu.elastic",
+        "ElasticController": "windflow_tpu.elastic",
+        "RescaleEvent": "windflow_tpu.elastic",
+        "RescaleError": "windflow_tpu.elastic",
+        "LoadReport": "windflow_tpu.elastic",
         # mesh-scale operators + mesh construction (multi-chip plane)
         "KeyFarmMesh": "windflow_tpu.operators.tpu.mesh_farm",
         "PaneFarmMesh": "windflow_tpu.operators.tpu.pane_mesh",

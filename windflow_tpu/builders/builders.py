@@ -35,6 +35,18 @@ def _alias_camel(cls):
             policy = getattr(self, "error_policy", "fail")
             if policy != "fail":
                 op.error_policy = policy
+            spec = getattr(self, "elasticity", None)
+            if spec is not None:
+                if op.parallelism > spec.max_replicas:
+                    raise ValueError(
+                        f"operator {op.name!r}: with_parallelism"
+                        f"({op.parallelism}) exceeds with_elasticity "
+                        f"max_replicas={spec.max_replicas}")
+                # starting parallelism is the declared one raised into
+                # the elastic interval (with_parallelism left at 1 under
+                # with_elasticity(2, 8) means "start at the minimum")
+                op.elasticity = spec
+                op.parallelism = max(op.parallelism, spec.min_replicas)
             return op
 
         build_wrapper._wf_wrapped = True
@@ -63,6 +75,7 @@ class _BuilderBase:
         self.parallelism = 1
         self.closing_func = None
         self.error_policy = "fail"
+        self.elasticity = None
 
     def with_name(self, name: str):
         self.name = name
@@ -85,6 +98,28 @@ class _BuilderBase:
         docs/RESILIENCE.md."""
         from ..resilience.policies import validate_policy
         self.error_policy = validate_policy(policy)
+        return self
+
+    def with_elasticity(self, min_replicas: int, max_replicas: int,
+                        target_util: float = 0.75):
+        """Declare this operator elastically scalable at runtime
+        (docs/ELASTIC.md): the elastic controller (or manual
+        ``PipeGraph.rescale``) adjusts its replica count inside
+        ``[min_replicas, max_replicas]``, steering toward
+        ``target_util`` busy fraction per replica.  Keys repartition by
+        the same ``hash % parallelism`` contract the KEYBY emitter
+        uses; per-key state (Accumulator) migrates across the rescale.
+        Supported for single-stage Filter/Map/FlatMap/Accumulator
+        operators in Mode.DEFAULT graphs."""
+        from ..core.basic import ElasticSpec
+        if min_replicas < 1 or max_replicas < min_replicas:
+            raise ValueError(
+                "with_elasticity: need 1 <= min_replicas <= max_replicas")
+        if not 0.0 < target_util <= 1.0:
+            raise ValueError(
+                "with_elasticity: target_util must be in (0, 1]")
+        self.elasticity = ElasticSpec(min_replicas, max_replicas,
+                                      target_util)
         return self
 
     def build_ptr(self):
@@ -237,6 +272,12 @@ class SourceBuilder(_BuilderBase):
                 "sources always fail hard: error policies apply to "
                 "per-tuple svc processing (docs/RESILIENCE.md)")
         return self
+
+    def with_elasticity(self, *a, **kw):
+        """Sources cannot rescale at runtime: rescaling a generation
+        loop would need offset repartitioning across replicas, which
+        only the source callable could define (docs/ELASTIC.md)."""
+        raise ValueError("sources are not elastically scalable")
 
     def build(self):
         if self._ingest_kind is None:

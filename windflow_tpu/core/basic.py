@@ -151,6 +151,21 @@ class WinOperatorConfig:
     slide_inner: int = 0
 
 
+@dataclass(frozen=True)
+class ElasticSpec:
+    """Per-operator elasticity declaration (builders
+    ``.with_elasticity(min, max, target_util)``; docs/ELASTIC.md).
+
+    The elastic controller keeps the operator's replica count inside
+    ``[min_replicas, max_replicas]``, steering toward ``target_util``
+    busy fraction per replica.  Manual ``PipeGraph.rescale`` calls are
+    bounded by the same interval."""
+
+    min_replicas: int
+    max_replicas: int
+    target_util: float = 0.75
+
+
 @dataclass
 class RuntimeConfig:
     """Global runtime knobs (folds the reference's macro set: README
@@ -209,3 +224,11 @@ class RuntimeConfig:
     # arena buffers instead of allocating per batch.  False = every
     # batch allocates fresh numpy columns (the pre-pool behaviour).
     buffer_pool: bool = True
+    # -- elastic scaling plane (elastic/; docs/ELASTIC.md) --------------
+    # elastic.controller.ElasticityConfig tuning the load-driven
+    # controller (sample period, EWMA alpha, cooldown, hysteresis,
+    # backlog trigger), or None for the defaults.  The controller only
+    # starts when some operator declared .with_elasticity(...); setting
+    # ``ElasticityConfig(enabled=False)`` keeps it off while manual
+    # PipeGraph.rescale(...) calls stay available.
+    elasticity: Any = None

@@ -58,6 +58,15 @@ def _is_collector(node: RtNode) -> bool:
         or isinstance(node.logic, (OrderingLogic, KSlackLogic))
 
 
+def _is_elastic(node: RtNode) -> bool:
+    # elastic replicas (elastic/rescale.py) are a fusion barrier like
+    # the ingest credit boundary: the rescale protocol rebuilds replica
+    # threads and rewires their channels at runtime, which requires the
+    # operator's nodes to stay their own threads with their own
+    # channels
+    return getattr(node, "elastic_group", None) is not None
+
+
 def _is_ingest_head(node: RtNode) -> bool:
     try:
         from ..ingest.sources import IngestSourceLogic
@@ -156,14 +165,14 @@ def _consumers_by_channel(graph) -> dict:
 
 def _try_linear(graph, consumers: dict) -> bool:
     for a in graph._all_nodes():
-        if _is_ingest_head(a) or _is_collector(a):
+        if _is_ingest_head(a) or _is_collector(a) or _is_elastic(a):
             continue
         sfd = _single_forward_dest(a)
         if sfd is None:
             continue
         ch, _outlet = sfd
         b = consumers.get(id(ch))
-        if b is None or b is a or _is_collector(b) \
+        if b is None or b is a or _is_collector(b) or _is_elastic(b) \
                 or not _tick_safe(a, b):
             continue
         _merge(graph, a, b)
@@ -178,7 +187,7 @@ def _try_stage_pattern(graph, consumers: dict) -> bool:
     # group candidate producers by their (identical) destination set
     groups: dict = {}
     for a in nodes:
-        if _is_ingest_head(a) or _is_collector(a):
+        if _is_ingest_head(a) or _is_collector(a) or _is_elastic(a):
             continue
         if len(a.outlets) != 1:
             continue
@@ -198,7 +207,8 @@ def _try_stage_pattern(graph, consumers: dict) -> bool:
         if any(ch.n_producers != n for ch in chans):
             continue  # someone else also feeds these consumers
         cons = [consumers.get(cid) for cid in key]
-        if any(c is None or _is_collector(c) for c in cons):
+        if any(c is None or _is_collector(c) or _is_elastic(c)
+               for c in cons):
             continue
         if len({id(c) for c in cons}) != n or \
                 any(c in producers for c in cons):

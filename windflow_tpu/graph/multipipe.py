@@ -90,6 +90,11 @@ class MultiPipe:
         entry_channels: List[Channel] = [make_channel(cfg) for _ in range(n)]
         # emitter clone per upstream producer (reference: emitter combined
         # into each tail node, multipipe.hpp:302-338)
+        if stage.elastic is not None and grouped:
+            raise ValueError(
+                f"stage {stage.name!r} cannot be elastic behind grouped "
+                "(complex-nesting) tails (docs/ELASTIC.md)")
+        elastic_outlets = []
         if grouped:
             # complex nesting: tails of group g feed only the replicas of
             # group g, through that group's emitter prototype
@@ -118,7 +123,9 @@ class MultiPipe:
                     em.set_child_widths(sizes)
                 dests = [(ch, ch.register_producer())
                          for ch in entry_channels]
-                tail.outlets.append(Outlet(em, dests))
+                outlet = Outlet(em, dests)
+                tail.outlets.append(outlet)
+                elastic_outlets.append(outlet)
         new_nodes: List[RtNode] = []
         replica_nodes: List[RtNode] = []
         for i, logic in enumerate(stage.replicas):
@@ -188,6 +195,29 @@ class MultiPipe:
             self.tails = replica_nodes
         self.nodes.extend(new_nodes)
         self._op_names.append(stage.name)
+        if stage.elastic is not None:
+            self._register_elastic(stage, replica_nodes, elastic_outlets)
+
+    def _register_elastic(self, stage: StageSpec, replica_nodes,
+                          outlets) -> None:
+        """Register a wired elastic stage with the graph (rescale
+        registry + always-on stats records for the load signals)."""
+        from ..elastic.rescale import ElasticHandle
+        key = f"{self.name}/{stage.name}"
+        if key in self.graph.elastic:
+            raise RuntimeError(f"elastic operator {key!r} already "
+                               "registered")
+        for i, node in enumerate(replica_nodes):
+            node.elastic_group = key
+            # load signals need service-time samples even when tracing
+            # is off; records registered here keep monitoring
+            # attribution consistent with the traced path
+            if node.stats is None:
+                node.stats = self.graph.stats.register(key, str(i))
+        self.graph.elastic[key] = ElasticHandle(
+            key, stage.elastic, self, stage.elastic_factory,
+            replica_nodes, outlets,
+            error_policy=stage.error_policy or "fail")
 
     # -- public API (multipipe.hpp add/chain surface) ----------------------
     def add_source(self, source: Operator) -> "MultiPipe":
@@ -224,13 +254,41 @@ class MultiPipe:
         if (self.graph.mode == Mode.DEFAULT and win_type == WinType.CB
                 and hasattr(op, "enable_renumbering")):
             op.enable_renumbering()
-        for i, stage in enumerate(op.stages()):
+        stages = op.stages()
+        self._prepare_elastic(op, stages)
+        for i, stage in enumerate(stages):
             if stage.error_policy is None:
                 stage.error_policy = getattr(op, "error_policy", "fail")
             if i == 0:
                 self._swap_cb_broadcast(stage, win_type)
             self._append_stage(stage, win_type)
         return self
+
+    def _prepare_elastic(self, op: Operator, stages: List[StageSpec]) -> None:
+        """Validate and mark an elastic declaration (docs/ELASTIC.md):
+        runtime rescaling needs a single collector-less stage whose
+        operator kind exposes a fresh-replica factory, in DEFAULT mode
+        (ordering collectors would pin per-channel identity the rescale
+        cannot preserve).  _append_stage registers the wired stage."""
+        spec = getattr(op, "elasticity", None)
+        if spec is None:
+            return
+        factory = op.elastic_logic_factory()
+        if (factory is None or len(stages) != 1
+                or stages[0].collector is not None
+                or stages[0].groups is not None
+                or stages[0].group_emitters is not None):
+            raise ValueError(
+                f"operator {op.name!r} cannot be elastic: runtime "
+                "rescaling supports single-stage Filter/Map/FlatMap/"
+                "Accumulator operators (docs/ELASTIC.md)")
+        if self.graph.mode != Mode.DEFAULT:
+            raise ValueError(
+                "elastic operators require Mode.DEFAULT: ordering/"
+                "K-slack collectors bind per-channel state the rescale "
+                "protocol does not migrate (docs/ELASTIC.md)")
+        stages[0].elastic = spec
+        stages[0].elastic_factory = factory
 
     def _swap_cb_broadcast(self, stage: StageSpec, win_type) -> None:
         """CB windows entering a window-multicast (WF-rooted) stage in
@@ -267,6 +325,12 @@ class MultiPipe:
         (multipipe.hpp:345-390; chain exists only for Filter/Map/
         FlatMap/Sink)."""
         self._check_open()
+        if getattr(op, "elasticity", None) is not None \
+                or any(t.elastic_group is not None for t in self.tails):
+            # thread fusion and runtime rescaling are mutually
+            # exclusive: a fused replica cannot be rebuilt/rewired per
+            # operator (docs/ELASTIC.md); wire through a channel instead
+            return self.add(op)
         if getattr(op, "error_policy", "fail") != "fail" \
                 or any(t.error_policy != "fail" for t in self.tails):
             # thread fusion would merge error-policy scopes: a fused

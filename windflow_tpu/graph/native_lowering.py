@@ -48,6 +48,10 @@ def _lower_plan(graph) -> Optional[dict]:
     if getattr(cfg, "fault_plan", None) is not None \
             or getattr(cfg, "watchdog_timeout_s", None):
         return None
+    # elastic operators (elastic/; docs/ELASTIC.md) need the threaded
+    # replica plane: a lowered run has no replicas to rescale
+    if getattr(graph, "elastic", None):
+        return None
     if len(graph.pipes) != 1:
         return None
     mp = graph.pipes[0]

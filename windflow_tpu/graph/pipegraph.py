@@ -66,6 +66,14 @@ class PipeGraph:
         # names of nodes the LEVEL2 compile pass fused (graph/fuse.py),
         # filled at start()
         self.fused_nodes: List[str] = []
+        # elastic scaling plane (elastic/; docs/ELASTIC.md): registry of
+        # rescalable operators (name -> ElasticHandle, filled at
+        # wiring), one rescale at a time, and the load-driven
+        # controller thread (started at start() when the registry is
+        # non-empty)
+        self.elastic = {}
+        self._rescale_lock = threading.Lock()
+        self._controller = None
 
     # -- construction ------------------------------------------------------
     def _new_pipe(self) -> MultiPipe:
@@ -264,6 +272,11 @@ class PipeGraph:
                 self, self.config.watchdog_timeout_s,
                 cancel=self.config.watchdog_cancel)
             self._watchdog.start()
+        # elastic controller LAST: its sampler reads live replica
+        # stats, and its decisions call rescale() on a running graph
+        if self.elastic:
+            from ..elastic.controller import start_controller
+            self._controller = start_controller(self)
 
     def cancel(self, reason: Optional[BaseException] = None) -> bool:
         """Poison every channel: blocked replicas unwind and wait_end
@@ -278,26 +291,39 @@ class PipeGraph:
         (errors, stuck) lists."""
         grace = self.config.cancel_grace_s
         errors, stuck = [], []
-        for n in self._all_nodes():
-            grace_deadline = None
-            while n.is_alive():
-                n.join(timeout=0.1)
-                if not n.is_alive():
-                    break
-                if self._cancel.cancelled:
-                    now = _time.monotonic()
-                    if grace_deadline is None:
-                        grace_deadline = now + grace
-                    elif now > grace_deadline:
-                        stuck.append(n.name)
+        # dedup by node OBJECT (held in the set): an id()-keyed set
+        # could skip a rescale-added replica that reuses a freed
+        # retired node's address
+        joined = set()
+        while True:
+            # re-list each pass: a concurrent elastic rescale may add
+            # replica nodes while this join loop is already running
+            pending = [n for n in self._all_nodes() if n not in joined]
+            if not pending:
+                break
+            for n in pending:
+                joined.add(n)
+                grace_deadline = None
+                while n.is_alive():
+                    n.join(timeout=0.1)
+                    if not n.is_alive():
                         break
-            if n.error is not None:
-                errors.append((n.name, n.error))
+                    if self._cancel.cancelled:
+                        now = _time.monotonic()
+                        if grace_deadline is None:
+                            grace_deadline = now + grace
+                        elif now > grace_deadline:
+                            stuck.append(n.name)
+                            break
+                if n.error is not None:
+                    errors.append((n.name, n.error))
         return errors, stuck
 
     def wait_end(self) -> None:
         errors, stuck = self._join_all()
         self._ended = True
+        if self._controller is not None:
+            self._controller.stop()
         if self._watchdog is not None:
             self._watchdog.stop()
         if self._monitor is not None:
@@ -352,6 +378,7 @@ class PipeGraph:
         <pid>_<op>.json + a PDF/SVG diagram)."""
         import os
         from ..monitoring.monitor import graph_to_dot, graph_to_svg
+        self.refresh_gauges()
         d = self.config.log_dir
         os.makedirs(d, exist_ok=True)
         pid = os.getpid()
@@ -447,6 +474,61 @@ class PipeGraph:
     def resume(self) -> None:
         self._pause_ctl.resume()
 
+    # -- elastic scaling plane (elastic/; docs/ELASTIC.md) --------------
+    def rescale(self, operator: str, new_parallelism: int,
+                trigger: str = "manual", timeout: float = 60.0):
+        """Rescale a running elastic operator to ``new_parallelism``
+        replicas with the pause-drain-migrate protocol
+        (elastic/rescale.py): quiesce, repartition keyed state by the
+        emitter's ``hash % parallelism`` contract, rebuild/retire
+        replica threads and rewire channels, resume.  In-flight tuples
+        are conserved (the pipeline is drained before any rewiring).
+
+        ``operator`` is the registry key (``"<pipe>/<name>"``) or any
+        unique substring of one (e.g. the builder name).  Returns the
+        recorded :class:`~windflow_tpu.elastic.RescaleEvent`, or None
+        when already at ``new_parallelism``."""
+        if not self._started:
+            raise RuntimeError("rescale() needs a started graph")
+        if self._ended:
+            raise RuntimeError("rescale() after wait_end()")
+        handle = self.elastic.get(operator)
+        if handle is None:
+            matches = [h for k, h in self.elastic.items() if operator in k]
+            if len(matches) != 1:
+                raise KeyError(
+                    f"no unique elastic operator matching {operator!r}; "
+                    f"registered: {sorted(self.elastic)}")
+            handle = matches[0]
+        from ..elastic.rescale import rescale_operator
+        with self._rescale_lock:
+            return rescale_operator(self, handle, new_parallelism,
+                                    trigger, timeout)
+
+    def refresh_gauges(self) -> None:
+        """Update the per-replica gauge fields of the stats records
+        (inbound channel depth; ingest credit-wait seconds) from the
+        live runtime objects.  Called before every stats JSON render
+        (monitoring reporter + log dump); cheap -- lock-free depth
+        reads (runtime/queues.Channel.depth)."""
+        from ..runtime.node import FusedLogic
+        for n in self._all_nodes():
+            logic = n.logic
+            rec = n.stats
+            if rec is None and isinstance(logic, FusedLogic):
+                # the channel consumer inside a fused node is its first
+                # segment; gauge attribution follows
+                rec = logic.segments[0].stats
+                logic = logic.segments[0].logic
+            if rec is None:
+                continue
+            ch = n.channel
+            if ch is not None:
+                rec.queue_depth = ch.depth
+            gate = getattr(logic, "gate", None)  # ingest source replicas
+            if gate is not None:
+                rec.credit_wait_s = gate.wait_time_s
+
     def live_checkpoint(self, path: str, timeout: float = 120.0) -> int:
         """Mid-stream snapshot: quiesce, save every replica's state
         (including ordering/K-slack collector buffers), resume.
@@ -454,11 +536,15 @@ class PipeGraph:
         at-least-once source replay from the checkpoint point."""
         from ..utils.checkpoint import graph_state
         import pickle
-        self.quiesce(timeout)
-        try:
-            state = graph_state(self)
-            with open(path, "wb") as f:
-                pickle.dump(state, f)
-        finally:
-            self.resume()
+        # serialize with elastic rescales: SourcePauseControl is a
+        # non-counting boolean, so a concurrent rescale's resume()
+        # would un-park sources mid-snapshot (and vice versa)
+        with self._rescale_lock:
+            self.quiesce(timeout)
+            try:
+                state = graph_state(self)
+                with open(path, "wb") as f:
+                    pickle.dump(state, f)
+            finally:
+                self.resume()
         return len(state)

@@ -238,6 +238,10 @@ class CreditedChannel:
         return self.inner.qsize()
 
     @property
+    def depth(self) -> int:
+        return self.inner.depth
+
+    @property
     def n_producers(self) -> int:
         return self.inner.n_producers
 

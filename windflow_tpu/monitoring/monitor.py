@@ -130,6 +130,9 @@ class MonitoringThread(threading.Thread):
 
     def _stats_json(self) -> str:
         stats = getattr(self.graph, "stats", None)
+        refresh = getattr(self.graph, "refresh_gauges", None)
+        if refresh is not None:
+            refresh()  # channel-depth / credit-wait gauges per replica
         if stats is not None:
             dls = getattr(self.graph, "dead_letters", None)
             return stats.to_json(self.graph.get_num_dropped_tuples(),

@@ -55,6 +55,13 @@ class StatsRecord:
     ingest_queue_depth: int = 0
     ingest_batch_size: int = 0
     controller_trace: list = field(default_factory=list)
+    # standalone gauges refreshed by PipeGraph.refresh_gauges before
+    # every report: tuples parked in this replica's inbound channel and
+    # cumulative seconds its source gate spent blocked on credits.
+    # Useful to operators on their own and the raw inputs of the
+    # elastic signal plane (elastic/signals.py)
+    queue_depth: int = 0
+    credit_wait_s: float = 0.0
 
     def observe(self, elapsed_us: float) -> None:
         self.samples += 1
@@ -81,6 +88,8 @@ class StatsRecord:
             "Device_launches": self.num_launches,
             "Bytes_to_device": self.bytes_to_device,
             "Bytes_from_device": self.bytes_from_device,
+            "Queue_depth": self.queue_depth,
+            "Credit_wait_s": round(self.credit_wait_s, 3),
         }
         if self.ingest_batch_size:     # ingest source replicas only
             d["Ingest_credits"] = self.credits_available
@@ -111,12 +120,27 @@ class GraphStats:
         self.graph_name = graph_name
         self.lock = threading.Lock()
         self.records: Dict[str, List[StatsRecord]] = {}
+        # elastic scaling plane (elastic/): records of retired replicas
+        # stay (terminated, history), so the LIVE parallelism of a
+        # rescaled operator is an explicit override; plus the rescale
+        # event log surfaced in the JSON
+        self.current_parallelism: Dict[str, int] = {}
+        self.rescale_events: List[dict] = []
 
     def register(self, operator_name: str, replica_id: str) -> StatsRecord:
         rec = StatsRecord(operator_name, replica_id)
         with self.lock:
             self.records.setdefault(operator_name, []).append(rec)
         return rec
+
+    def set_parallelism(self, operator_name: str, n: int) -> None:
+        with self.lock:
+            self.current_parallelism[operator_name] = n
+
+    def record_rescale(self, event) -> None:
+        """Append a completed RescaleEvent (elastic/rescale.py)."""
+        with self.lock:
+            self.rescale_events.append(event.to_dict())
 
     def to_json(self, dropped_tuples: int = 0,
                 dead_letter_tuples: int = 0) -> str:
@@ -125,7 +149,8 @@ class GraphStats:
                 {
                     "Operator_name": name,
                     "Operator_type": name.rsplit("/", 1)[-1],
-                    "Parallelism": len(replicas),
+                    "Parallelism": self.current_parallelism.get(
+                        name, len(replicas)),
                     "Replicas": [r.to_dict() for r in replicas],
                 }
                 for name, replicas in self.records.items()
@@ -134,6 +159,7 @@ class GraphStats:
                                for rs in self.records.values() for r in rs)
             shed_tuples = sum(r.tuples_shed
                               for rs in self.records.values() for r in rs)
+            rescales = list(self.rescale_events)
         return json.dumps({
             "PipeGraph_name": self.graph_name,
             "Mode": "DEFAULT",
@@ -147,6 +173,11 @@ class GraphStats:
             # ingest admission control (ingest/admission.py): tuples
             # shed under overload (also quarantined above)
             "Shed_tuples": shed_tuples,
+            # elastic scaling plane (elastic/; docs/ELASTIC.md):
+            # completed runtime rescales (timestamp, operator,
+            # old -> new parallelism, trigger signal)
+            "Rescales": len(rescales),
+            "Rescale_events": rescales,
             "Memory_usage_KB": get_mem_usage_kb(),
             "Operator_number": len(ops),
             "Operators": ops,

@@ -210,12 +210,17 @@ function opRow(op) {
   const ing = rs.some(r => "Ingest_batch_size" in r) ?
     `${fmt(sum("Ingest_credits"))}cr q${fmt(sum("Ingest_queue_depth"))} ` +
     `b${fmt(sum("Ingest_batch_size"))}` : "–";
+  // standalone load gauges (refresh_gauges): inbound channel depth and
+  // credit-wait seconds -- the elastic signal plane's raw inputs
+  const cwait = sum("Credit_wait_s");
   return `<tr><td>${esc(op.Operator_name)}</td><td>${num(op.Parallelism)}</td>
     <td>${fmt(sum("Inputs_received"))}</td>
     <td>${fmt(sum("Outputs_sent"))}</td>
     <td>${fmt(sum("Inputs_ignored"))}</td>
     <td>${fmt(sum("Svc_failures"))}</td>
     <td>${fmt(sum("Shed_tuples"))}</td>
+    <td>${fmt(sum("Queue_depth"))}</td>
+    <td>${cwait ? cwait.toFixed(1) + "s" : "–"}</td>
     <td>${ing}</td>
     <td>${svc.toFixed(1)}</td>
     <td>${fmt(sum("Device_launches"))}</td>
@@ -259,6 +264,11 @@ function render(apps) {
           <div class="k">shed tuples (admission)</div></div>
         <div class="tile"><div class="v">${replicas}</div>
           <div class="k">replicas (${num(rep.Operator_number)} ops)</div></div>
+        <div class="tile"><div class="v">${fmt(rep.Rescales || 0)}</div>
+          <div class="k">rescale events${(rep.Rescale_events || []).length
+            ? " (last " + esc((e => e.old_parallelism + "\\u2192" +
+              e.new_parallelism)(rep.Rescale_events[
+                rep.Rescale_events.length - 1])) + ")" : ""}</div></div>
         <div class="tile"><div class="v">
           ${fmt(num(rep.Memory_usage_KB) * 1024)}B</div>
           <div class="k">resident memory</div></div>
@@ -267,6 +277,7 @@ function render(apps) {
       <div class="spark-wrap">${sparkline(id, hist[id])}</div>
       <table><thead><tr><th>operator</th><th>par</th><th>in</th>
         <th>out</th><th>ignored</th><th>fails</th><th>shed</th>
+        <th>q-depth</th><th>cr-wait</th>
         <th>ingest</th><th>svc &micro;s</th>
         <th>launches</th><th>B&rarr;dev</th><th>B&larr;dev</th></tr>
       </thead><tbody>${ops.map(opRow).join("")}</tbody></table>

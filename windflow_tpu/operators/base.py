@@ -47,6 +47,13 @@ class StageSpec:
     # from the operator descriptor at wiring (resilience/policies.py);
     # applies to the stage's replica nodes, never to collectors
     error_policy: Optional[str] = None
+    # elastic scaling (elastic/; docs/ELASTIC.md): the operator's
+    # ElasticSpec plus a ``(replica_index, parallelism) -> NodeLogic``
+    # factory, filled by MultiPipe.add for single-stage operators that
+    # declared .with_elasticity(...).  _append_stage registers the
+    # wired stage with the graph's elastic registry.
+    elastic: Optional[object] = None
+    elastic_factory: Optional[object] = None
 
 
 class Operator:
@@ -64,6 +71,9 @@ class Operator:
         # per-tuple svc failure handling (resilience/policies.py);
         # builders set it via .with_error_policy(...)
         self.error_policy = "fail"
+        # ElasticSpec when the builder declared .with_elasticity(...)
+        # (elastic/; docs/ELASTIC.md); None = fixed parallelism
+        self.elasticity = None
 
     # -- to be provided by subclasses --------------------------------------
     def stages(self) -> List[StageSpec]:
@@ -72,6 +82,13 @@ class Operator:
     # chainable operators (Filter/Map/FlatMap/Sink) additionally expose
     # fresh per-replica logics for thread fusion (multipipe.hpp:345-390)
     def chain_logics(self) -> Optional[List[NodeLogic]]:
+        return None
+
+    # elastically scalable operators expose a fresh-replica factory for
+    # runtime rescaling: ``factory(replica_index, parallelism) ->
+    # NodeLogic`` (elastic/rescale.py).  None = this operator kind
+    # cannot be rescaled at runtime.
+    def elastic_logic_factory(self):
         return None
 
     def is_window_operator(self) -> bool:

@@ -131,6 +131,14 @@ class AccumulatorLogic(_ReplicaLogic):
     def load_state(self, st):
         self.state = st["state"]
 
+    # -- keyed-state hooks (elastic/rescale.py): the per-key fold store
+    # repartitions over a new replica count at runtime rescale --------
+    def keyed_state_dict(self):
+        return dict(self.state)
+
+    def load_keyed_state(self, kv):
+        self.state = dict(kv)
+
 
 class SinkLogic(_ReplicaLogic):
     def __init__(self, fn, parallelism, replica_index, closing_func):
@@ -174,9 +182,9 @@ class _BasicOp(Operator):
         self.closing_func = closing_func
         self.keyed = keyed
 
-    def _make_logic(self, i):
-        return self.logic_cls(self.fn, self.base_arity, self.parallelism, i,
-                              self.closing_func)
+    def _make_logic(self, i, n=None):
+        return self.logic_cls(self.fn, self.base_arity,
+                              n or self.parallelism, i, self.closing_func)
 
     def stages(self):
         reps = [self._make_logic(i) for i in range(self.parallelism)]
@@ -188,6 +196,13 @@ class _BasicOp(Operator):
         if self.keyed:
             return None  # KEYBY ops cannot be thread-fused (multipipe chain)
         return [self._make_logic(i) for i in range(self.parallelism)]
+
+    def elastic_logic_factory(self):
+        """Fresh replica logics for runtime rescaling (elastic/): the
+        basic ops are stateless per replica (their emissions depend only
+        on the tuple), so any replica count is semantically equivalent;
+        keyed variants repartition by ``hash % n`` like the emitter."""
+        return self._make_logic
 
 
 class Filter(_BasicOp):
@@ -271,6 +286,12 @@ class Accumulator(Operator):
         return [StageSpec(self.name, reps, StandardEmitter(keyed=True),
                           self.routing, ordering_mode=OrderingMode.TS)]
 
+    def elastic_logic_factory(self):
+        """Rescalable: per-key fold state migrates through the
+        keyed-state hooks (elastic/rescale.py)."""
+        return lambda i, n: AccumulatorLogic(
+            self.fn, n, i, self.closing_func, self.init_value)
+
 
 class Sink(_BasicOp):
     logic_cls = SinkLogic
@@ -281,5 +302,12 @@ class Sink(_BasicOp):
         super().__init__(fn, parallelism, name, closing_func, keyed,
                          Pattern.SINK)
 
-    def _make_logic(self, i):
-        return SinkLogic(self.fn, self.parallelism, i, self.closing_func)
+    def _make_logic(self, i, n=None):
+        return SinkLogic(self.fn, n or self.parallelism, i,
+                         self.closing_func)
+
+    def elastic_logic_factory(self):
+        # a sink's eos_flush IS the end-of-stream signal (fn(None),
+        # sink.hpp:73-77); retiring a replica mid-stream would fire it
+        # early, so sinks keep their build-time parallelism
+        return None

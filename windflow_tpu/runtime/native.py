@@ -290,6 +290,13 @@ class NativeChannel:
     def qsize(self) -> int:
         return self.lib.wfn_channel_size(self.ptr)
 
+    @property
+    def depth(self) -> int:
+        """Depth gauge (monitoring/elastic samplers): the C++ size read
+        is already lock-cheap, so this just mirrors the pure-Python
+        channel's surface."""
+        return self.lib.wfn_channel_size(self.ptr)
+
     def __del__(self):
         try:
             lib, ptr = getattr(self, "lib", None), getattr(self, "ptr", None)

@@ -66,6 +66,21 @@ class NodeLogic:
     def load_state(self, state) -> None:
         raise NotImplementedError(f"{type(self).__name__} is stateless")
 
+    # -- keyed-state hooks (elastic/rescale.py): a logic whose state is
+    # a per-key mapping exposes it so a runtime rescale can repartition
+    # keys over a new replica count -------------------------------------
+    def keyed_state_dict(self):
+        """``{key: state}`` snapshot for key repartitioning; None =
+        stateless (nothing to migrate at rescale)."""
+        return None
+
+    def load_keyed_state(self, kv) -> None:
+        """Replace this replica's per-key state with ``kv`` (the keys
+        this replica owns under the new routing); clears keys it no
+        longer owns."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no keyed state")
+
 
 class ChainedLogic(NodeLogic):
     """Thread fusion of two logics: b consumes a's emissions inline
@@ -492,6 +507,12 @@ class RtNode(threading.Thread):
         # wiring marks collector nodes (ordering/K-slack/farm merge)
         # structurally; the fusion pass must never fuse across them
         self.is_collector = False
+        # elastic-operator membership (elastic/rescale.py): the handle
+        # key when this replica belongs to a runtime-rescalable stage.
+        # The compile pass must not fuse such nodes (rescale rebuilds
+        # replica threads and rewires their channels at runtime), and
+        # chain() falls back to add() for them.
+        self.elastic_group = None
         # drain detection for the live-checkpoint barrier: an item is
         # in flight while taken != done
         self.taken = 0

@@ -242,6 +242,14 @@ class Channel:
         with self._lock:
             return len(self._items)
 
+    @property
+    def depth(self) -> int:
+        """Lock-free depth gauge: ``len`` of a deque is GIL-atomic, so
+        monitoring/elastic samplers can read it without contending on
+        the channel lock.  Gauge-grade (may lag a concurrent put/get by
+        one item), like the puts/gets counters."""
+        return len(self._items)
+
 
 _native_warned = False
 
