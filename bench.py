@@ -404,6 +404,72 @@ def run_elastic_step(n_events, svc_us=1000.0, low_rate=500.0, burst=4.0):
     return n_events / dt, lats, events, (sunk, n_events)
 
 
+def run_planner_feed(n_events, feeders=2, placement="auto",
+                     source_batch=None, adaptive=True):
+    """Config #2j: parallel zero-copy feed (ingest/feed.FeedSource -- N
+    feeder threads materializing through the shared ColumnPool arena,
+    delivery ordered by the turnstile) through the cost-based placement
+    planner into the same WinSeqTPU engine as #2f.  ``placement``
+    pins the lane for the vs-pure-lane comparisons ('device' = the 2f
+    engine fed by the parallel feeders; 'host' = the numpy host lane);
+    'auto' lets the planner decide from the measured RTT floor +
+    calibrated host rate.  Returns per-launch device timing from the
+    stats JSON so the report can split transport from compute."""
+    import windflow_tpu as wf
+    from windflow_tpu.graph.fuse import find_logic
+    from windflow_tpu.ingest.feed import FeedSource
+    from windflow_tpu.operators.basic_ops import Sink
+    from windflow_tpu.operators.tpu.win_seq_tpu import (WinSeqTPU,
+                                                        WinSeqTPULogic)
+
+    sb = source_batch or SOURCE_BATCH
+    assert sb % N_KEYS == 0
+    n_chunks = max(1, n_events // sb)
+    n_events = n_chunks * sb  # whole chunks only
+    stamps = [0.0] * n_chunks
+    value_pool = np.random.default_rng(0).random(sb).astype(np.float32)
+
+    def chunk_fn(i, take):
+        if i >= n_chunks:
+            return None
+        idx = take(sb, np.int64)
+        idx[:] = np.arange(i * sb, (i + 1) * sb)
+        keys = np.mod(idx, N_KEYS, out=take(sb, np.int64))
+        ids = np.floor_divide(idx, N_KEYS, out=idx)  # idx is scratch
+        vals = take(sb, np.float32)
+        vals[:] = value_pool
+        stamps[i] = time.perf_counter()
+        return keys, ids, ids, vals
+
+    g = wf.PipeGraph("bench2j", wf.Mode.DEFAULT)
+    op = WinSeqTPU("sum", WIN, SLIDE, wf.WinType.TB,
+                   batch_len=DEVICE_BATCH, emit_batches=True,
+                   max_buffer_elems=MAX_BUFFER, inflight_depth=INFLIGHT,
+                   placement=placement, adaptive_batch=adaptive)
+    sink = _WindowLatencySink(stamps, sb)
+    g.add_source(FeedSource(chunk_fn, feeders=feeders)) \
+        .add(op).add_sink(Sink(sink))
+    t0 = time.perf_counter()
+    g.run()
+    dt = time.perf_counter() - t0
+    dev = {}
+    rep = json.loads(g.stats.to_json())
+    for o in rep["Operators"]:
+        for r in o["Replicas"]:
+            if r["Device_launches"]:
+                dev = {"launches": r["Device_launches"],
+                       "device_time_ms": r["Device_time_ms"],
+                       "bytes_per_launch": r.get("Device_bytes_per_launch"),
+                       "roofline_frac": r.get("Device_roofline_frac")}
+    logic = find_logic(g, lambda lg: isinstance(lg, WinSeqTPULogic))
+    if logic is not None:
+        dev["final_batch_len"] = logic.batch_len
+        if logic._adaptive is not None:
+            dev["batch_resizes"] = list(logic._adaptive.resizes)
+    return (n_events / dt, sink.windows, sink.lats,
+            rep.get("Placements", []), dev)
+
+
 def run_cpu_chain(n_events):
     """Config #1: declared map->filter->keyed window chain on the host
     plane.  Graph lowering folds the declared chain into the columnar
@@ -484,7 +550,7 @@ def run_key_farm_tpu(n_events, par=2):
     return n_events / dt, sink.windows
 
 
-def run_yahoo(n_events):
+def run_yahoo(n_events, placement="device"):
     """Config #5: Yahoo Streaming Benchmark windowed join+count
     (models/yahoo.py pipeline on the device plane)."""
     import windflow_tpu as wf
@@ -494,19 +560,30 @@ def run_yahoo(n_events):
     g = wf.PipeGraph("bench5", wf.Mode.DEFAULT)
     build_pipeline(g, n_events, batch_size=SOURCE_BATCH,
                    device_batch=DEVICE_BATCH, sink=sink,
-                   win_len=1 << 20, slide_len=1 << 20)
+                   win_len=1 << 20, slide_len=1 << 20,
+                   placement=placement)
     t0 = time.perf_counter()
     g.run()
     dt = time.perf_counter() - t0
     return n_events / dt, sink.windows
 
 
-def run_nexmark(query, n_bids, opt_level=None):
+# Q7 tumbling-window length: at the 16M-bid bench size this fires
+# ~1953 windows (>= 1000), so the device lane amortizes launch
+# overhead across many windows instead of measuring a handful of
+# launches (the old 1<<18 fired only 61 windows at 16M)
+Q7_WIN = 1 << 13
+Q5_WIN, Q5_SLIDE = 1 << 18, 1 << 17
+
+
+def run_nexmark(query, n_bids, opt_level=None, placement="device"):
     """Config #6: NEXMark-style queries, the second application family
     (models/nexmark.py).  Q5 = per-auction sliding-window bid counts
     (KeyFarmTPU 'count'); Q7 = global per-window highest bid
     (WinSeqTPU 'max' after the Q1 currency map).  ``opt_level`` pins
-    the graph compile pass for the fused-vs-unfused delta report."""
+    the graph compile pass for the fused-vs-unfused delta report;
+    ``placement`` pins or delegates the engine lane (the planner's
+    application-family criterion runs all three)."""
     import windflow_tpu as wf
     from windflow_tpu.models.nexmark import (build_q5_hot_items,
                                              build_q7_highest_bid)
@@ -518,19 +595,95 @@ def run_nexmark(query, n_bids, opt_level=None):
     nex_batch = 4 * DEVICE_BATCH  # fewer, larger launches: the bid
     #                                 stream fires many small windows
     if query == "q5":
-        build_q5_hot_items(g, n_bids, 1 << 18, 1 << 17, sink,
+        build_q5_hot_items(g, n_bids, Q5_WIN, Q5_SLIDE, sink,
                            batch_size=SOURCE_BATCH,
                            device_batch=nex_batch,
-                           inflight_depth=INFLIGHT)
+                           inflight_depth=INFLIGHT,
+                           placement=placement)
     else:
-        build_q7_highest_bid(g, n_bids, 1 << 18, sink,
+        build_q7_highest_bid(g, n_bids, Q7_WIN, sink,
                              batch_size=SOURCE_BATCH,
                              device_batch=nex_batch,
-                             inflight_depth=INFLIGHT)
+                             inflight_depth=INFLIGHT,
+                             placement=placement)
     t0 = time.perf_counter()
     g.run()
     dt = time.perf_counter() - t0
     return n_bids / dt, sink.windows
+
+
+def run_yahoo_baseline(n_events, win_len=1 << 20, slide_len=1 << 20):
+    """Native record-plane twin of config #5 (VERDICT satellite): the
+    identical Yahoo workload through the reference-architecture C++
+    engine (thread-per-stage, SPSC rings).  The views filter and
+    ad->campaign join are applied as vectorized feed-side prep -- the
+    same numpy work the framework's BatchFilter/BatchMap stages do --
+    so the measured difference is the windowed-count plane itself."""
+    from windflow_tpu.models.yahoo import (VIEW, make_campaign_map,
+                                           synth_events)
+    from windflow_tpu.runtime.native import (NativeRecordPipeline,
+                                             native_available)
+    if not native_available():
+        return None
+    batch = SOURCE_BATCH
+    pool = synth_events(batch, 1000, seed=0)
+    campaign = make_campaign_map(1000, 100)
+    ones = np.ones(batch, np.float64)
+    rp = NativeRecordPipeline("threaded", 1)
+    rp.add_window(win_len, slide_len, True, "count")
+    rp.set_feed()
+    t0 = time.perf_counter()
+    rp.start()
+    sent = 0
+    while sent < n_events:
+        n = min(batch, n_events - sent)
+        mask = pool["event_type"][:n] == VIEW
+        ts = (sent + pool["ts"][:n])[mask]
+        keys = campaign[pool["ad_id"][:n][mask]]
+        rp.feed(keys, ts, ts, ones[:len(ts)])
+        sent += n
+    rp.feed_eos()
+    rp.wait()
+    return n_events / (time.perf_counter() - t0)
+
+
+def run_nexmark_baseline(query, n_bids):
+    """Native record-plane twins of config #6 (VERDICT satellite):
+    the same bid stream and window shapes through the reference-
+    architecture C++ engine.  Q5 = keyed windowed count per auction;
+    Q7 = the Q1 currency map (feed-side numpy, mirroring the
+    framework's BatchMap) then the global windowed max."""
+    from windflow_tpu.models.nexmark import DOL_TO_EUR, synth_bids
+    from windflow_tpu.runtime.native import (NativeRecordPipeline,
+                                             native_available)
+    if not native_available():
+        return None
+    batch = SOURCE_BATCH
+    pool = synth_bids(batch, 1000, 7)
+    rp = NativeRecordPipeline("threaded", 1)
+    if query == "q5":
+        rp.add_window(Q5_WIN, Q5_SLIDE, True, "count")
+        keys_t, vals_t = pool["auction"], np.ones(batch, np.float64)
+    else:
+        rp.add_window(Q7_WIN, Q7_WIN, True, "max")
+        keys_t = np.zeros(batch, np.int64)
+        vals_t = None  # per-batch currency map, like the framework's
+    rp.set_feed()
+    t0 = time.perf_counter()
+    rp.start()
+    sent = 0
+    while sent < n_bids:
+        n = min(batch, n_bids - sent)
+        ts = sent + pool["ts"][:n]
+        if vals_t is None:  # q7: the BatchMap work is per batch
+            vals = pool["price"][:n] * DOL_TO_EUR
+        else:
+            vals = vals_t[:n]
+        rp.feed(keys_t[:n], ts, ts, vals)
+        sent += n
+    rp.feed_eos()
+    rp.wait()
+    return n_bids / (time.perf_counter() - t0)
 
 
 def run_record_chain_host(n_records, opt_level=None):
@@ -742,8 +895,50 @@ def main():
         "latency_before": _phase(0),
         "latency_during_burst": _phase(1),
         "latency_after": _phase(2)}
+    # parallel zero-copy feed through the placement planner (2j): the
+    # auto lane vs both pinned lanes (the "never loses" criterion),
+    # with the per-launch device-time breakdown splitting transport
+    # from compute behind the tunnel (docs/PLANNER.md)
+    rate2j, w2j, lat_j, plc_j, dev_j = run_planner_feed(
+        N_EVENTS, feeders=2, placement="auto")
+    p50j, p99j = _pcts(lat_j)
+    # the pinned lanes run at the SAME event count as the auto lane:
+    # compile/probe amortization differs with N, and the never-loses
+    # criterion is only meaningful at equal N
+    rate2jd, _wd, _ld, _pd, _dd = run_planner_feed(
+        N_EVENTS, feeders=2, placement="device")
+    rate2jh, _wh, _lh, _ph, _dh = run_planner_feed(
+        N_EVENTS, feeders=2, placement="host")
+    # transport only exists on the device lane; a host-resolved run's
+    # Device_time_ms is pure compute wall
+    on_device = bool(plc_j) and plc_j[0]["placement"] == "device"
+    transport_est = round(
+        dev_j.get("launches", 0) * rtt_ms, 1) if on_device else 0.0
+    compute_est = round(max(0.0, dev_j.get("device_time_ms", 0.0)
+                            - transport_est), 1)
+    configs["2j_planner_feed"] = {
+        "rate": round(rate2j, 1), "windows": w2j,
+        "window_latency_p50_ms": p50j, "window_latency_p99_ms": p99j,
+        "vs_baseline": _vs(rate2j),
+        "vs_feed": round(rate2j / rate2f, 2),
+        "placement": (plc_j[0]["placement"] if plc_j else None),
+        "lane_rates": {"auto": round(rate2j, 1),
+                       "device": round(rate2jd, 1),
+                       "host": round(rate2jh, 1)},
+        # acceptance: auto never loses to either pure lane (10% noise
+        # allowance on this shared box)
+        "auto_not_worse": rate2j >= 0.9 * min(rate2jd, rate2jh),
+        "device_time_ms": dev_j.get("device_time_ms"),
+        "launches": dev_j.get("launches"),
+        "bytes_per_launch": dev_j.get("bytes_per_launch"),
+        "est_transport_ms": transport_est,
+        "est_compute_ms": compute_est,
+        "final_batch_len": dev_j.get("final_batch_len"),
+        "batch_resizes": dev_j.get("batch_resizes", [])}
     # configs 3/4 run the same workload as the baseline, so they carry
-    # vs_baseline too; 5/6 are different workloads (no ratio)
+    # vs_baseline too; 5/6 get native record-plane baseline TWINS
+    # (run_yahoo_baseline / run_nexmark_baseline): same workload, same
+    # window shapes, reference thread-per-stage architecture
     rate3, w3 = run_pane_farm_tpu(32_000_000)
     configs["3_pane_farm_tpu"] = {"rate": round(rate3, 1), "windows": w3,
                                   "vs_baseline": _vs(rate3)}
@@ -751,7 +946,11 @@ def main():
     configs["4_key_farm_tpu"] = {"rate": round(rate4, 1), "windows": w4,
                                  "vs_baseline": _vs(rate4)}
     rate5, w5 = run_yahoo(16_000_000)
-    configs["5_yahoo_wmr"] = {"rate": round(rate5, 1), "windows": w5}
+    base5 = run_yahoo_baseline(16_000_000)
+    configs["5_yahoo_wmr"] = {
+        "rate": round(rate5, 1), "windows": w5,
+        "baseline_rate": round(base5, 1) if base5 else None,
+        "vs_baseline": round(rate5 / base5, 2) if base5 else None}
     # NexMark at both fusion levels: fused_delta = LEVEL2 / LEVEL0
     # (the compile pass's win on the per-hop-heavy query pipelines).
     # Per-query warmup first: each query's engine kind XLA-compiles on
@@ -760,10 +959,13 @@ def main():
         run_nexmark(q, 2_000_000)
         rq0, _wq0 = run_nexmark(q, 16_000_000, opt_level=OptLevel.LEVEL0)
         rq, wq = run_nexmark(q, 16_000_000, opt_level=OptLevel.LEVEL2)
+        baseq = run_nexmark_baseline(q, 16_000_000)
         configs[f"6_nexmark_{q}"] = {
             "rate": round(rq, 1), "windows": wq,
             "rate_unfused": round(rq0, 1),
-            "fused_delta": round(rq / rq0, 2)}
+            "fused_delta": round(rq / rq0, 2),
+            "baseline_rate": round(baseq, 1) if baseq else None,
+            "vs_baseline": round(rq / baseq, 2) if baseq else None}
     # the record plane (Python-callable chain, natively un-lowerable):
     # the config where the per-hop cv round trip was the whole cost
     r7_0, _c7 = run_record_chain_host(200_000,

@@ -54,6 +54,14 @@ def measure() -> dict:
     r, _w, _dt, _lat = bench.run_win_seq_tpu(
         N_SMALL, chunked=False, opt_level=OptLevel.LEVEL2)
     out["2f_win_seq_tpu_feed"] = round(r, 1)
+    # planner feed (2j): parallel zero-copy feeders through auto
+    # placement, plus both pinned lanes -- a cliff in 'auto' alone
+    # means the planner picked the losing lane
+    for lane in ("auto", "device", "host"):
+        r, _w, _lat, _plc, _dev = bench.run_planner_feed(
+            N_SMALL, feeders=2, placement=lane)
+        key = "2j_planner_feed" + ("" if lane == "auto" else f"_{lane}")
+        out[key] = round(r, 1)
     for q in ("q5", "q7"):
         # per-query warmup: each query's engine ('count'/'max') XLA-
         # compiles on first launch; without this the compile lands in

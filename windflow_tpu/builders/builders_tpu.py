@@ -25,10 +25,47 @@ class _TPUBuilderMixin:
     max_buffer_elems = DEFAULT_MAX_BUFFER_ELEMS
     inflight_depth = DEFAULT_INFLIGHT_DEPTH
     max_batch_delay_ms = DEFAULT_MAX_BATCH_DELAY_MS
+    placement = "device"
+    adaptive_batch = False
 
     def with_batch(self, batch_len: int):
         self.batch_len = batch_len
         return self
+
+    def with_placement(self, placement: str):
+        """Engine lane: 'device' (XLA launches -- the default, status
+        quo), 'host' (numpy host engine: no transport, no launch
+        floor), or 'auto' (the cost-based placement planner resolves
+        the lane at PipeGraph.start from the measured RTT floor, the
+        calibrated host rate and this operator's bytes/launch --
+        graph/planner.py; docs/PLANNER.md)."""
+        from ..operators.tpu.win_seq_tpu import PLACEMENTS
+        if placement not in PLACEMENTS:
+            raise ValueError(
+                f"placement must be one of {PLACEMENTS}: {placement!r}")
+        self.placement = placement
+        return self
+
+    withPlacement = with_placement
+
+    def with_adaptive_batch(self, on: bool = True):
+        """x2 / /2 device-batch resize driven by observed launch
+        latency vs the measured RTT floor (the adaptation loop of
+        win_seq_gpu.hpp:574-592; docs/PLANNER.md)."""
+        self.adaptive_batch = on
+        return self
+
+    withAdaptiveBatch = with_adaptive_batch
+
+    def _check_placement_supported(self):
+        """Builders whose operators cannot change lanes (FFAT trees,
+        device MAP/REDUCE composites) reject non-default placement
+        loudly instead of ignoring it."""
+        if self.placement != "device" or self.adaptive_batch:
+            raise ValueError(
+                f"{type(self).__name__} is device-pinned: "
+                "with_placement/with_adaptive_batch are not supported "
+                "on this operator family")
 
     def with_max_buffer(self, elems: int):
         """Host staging-buffer capacity (elements) for the device
@@ -108,7 +145,9 @@ class WinSeqTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                          self.closing_func, self.emit_batches,
                          max_buffer_elems=self.max_buffer_elems,
                          inflight_depth=self.inflight_depth,
-                         max_batch_delay_ms=self.max_batch_delay_ms)
+                         max_batch_delay_ms=self.max_batch_delay_ms,
+                         placement=self.placement,
+                         adaptive_batch=self.adaptive_batch)
 
 
 @_alias_camel
@@ -133,6 +172,7 @@ class WinFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
         if isinstance(self.fn, (PaneFarmTPU, WinMapReduceTPU)):
             # device nesting ctor (win_farm_gpu.hpp:73-76): replicate
             # the inner device operator; windowing comes from the inner
+            self._check_placement_supported()
             return NestedWinFarm(self.fn, self.parallelism, self.name,
                                  self.ordered, self.opt_level)
         self._check_windows()
@@ -143,7 +183,9 @@ class WinFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                           self.opt_level,
                           max_buffer_elems=self.max_buffer_elems,
                           inflight_depth=self.inflight_depth,
-                          max_batch_delay_ms=self.max_batch_delay_ms)
+                          max_batch_delay_ms=self.max_batch_delay_ms,
+                          placement=self.placement,
+                          adaptive_batch=self.adaptive_batch)
 
 
 @_alias_camel
@@ -165,6 +207,7 @@ class KeyFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin,
         from ..operators.nesting import NestedKeyFarm
         if isinstance(self.fn, (PaneFarmTPU, WinMapReduceTPU)):
             # device nesting ctor (key_farm_gpu.hpp:254-...)
+            self._check_placement_supported()
             return NestedKeyFarm(self.fn, self.parallelism, self.name,
                                  self.opt_level)
         self._check_windows()
@@ -176,7 +219,9 @@ class KeyFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin,
                           max_buffer_elems=self.max_buffer_elems,
                           coalesce=self.coalesce,
                           inflight_depth=self.inflight_depth,
-                          max_batch_delay_ms=self.max_batch_delay_ms)
+                          max_batch_delay_ms=self.max_batch_delay_ms,
+                          placement=self.placement,
+                          adaptive_batch=self.adaptive_batch)
 
 
 @_alias_camel
@@ -215,7 +260,9 @@ class PaneFarmTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                            max_buffer_elems=self.max_buffer_elems,
                            inflight_depth=self.inflight_depth,
                            max_batch_delay_ms=self.max_batch_delay_ms,
-                           emit_batches=self.emit_batches)
+                           emit_batches=self.emit_batches,
+                           placement=self.placement,
+                           adaptive_batch=self.adaptive_batch)
 
 
 @_alias_camel
@@ -244,6 +291,7 @@ class WinMapReduceTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
 
     def build(self) -> WinMapReduceTPU:
         self._check_windows()
+        self._check_placement_supported()
         return WinMapReduceTPU(self.fn, self.reduce_stage, self.win_len,
                                self.slide_len, self.win_type, self.par1,
                                self.par2, self.map_on_tpu, self.batch_len,
@@ -299,6 +347,7 @@ class WinSeqFFATTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
 
     def build(self):
         self._check_windows()
+        self._check_placement_supported()
         if not self.rebuild:
             from ..operators.tpu.ffat_resident import WinSeqFFATResident
             fn, neutral = self._resident_combine()
@@ -330,6 +379,7 @@ class KeyFFATTPUBuilder(_WinBuilderBase, _TPUBuilderMixin,
 
     def build(self) -> KeyFFATTPU:
         self._check_windows()
+        self._check_placement_supported()
         return KeyFFATTPU(self.fn, self.combine, self.win_len,
                           self.slide_len, self.win_type, self.parallelism,
                           self.batch_len, self.triggering_delay, self.name,

@@ -227,6 +227,15 @@ class PipeGraph:
         # fault plans bind per fused segment.
         from .fuse import fuse_graph
         self.fused_nodes = fuse_graph(self)
+        # cost-based placement planner (graph/planner.py;
+        # docs/PLANNER.md): resolve every window engine's lane
+        # ('auto' -> measured cost model; pins pass through), hand the
+        # device lanes the measured RTT floor for the adaptive batch
+        # resize, and give placed engines stats records so per-launch
+        # device timing is observable without tracing.  AFTER fusion
+        # (segments carry the engines now), BEFORE any thread starts.
+        from .planner import plan_graph
+        self.placements = plan_graph(self)
         # attach the column pool to every node and emitter (pooled
         # materialization + partition sub-batches)
         if self.buffer_pool is not None:

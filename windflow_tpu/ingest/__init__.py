@@ -34,6 +34,7 @@ from .admission import (ADMISSION_POLICIES, AdmissionConfig, ShedTuples)
 from .codec import StreamDecoder, decode_batch, encode_batch
 from .controller import MicrobatchController
 from .credits import CreditGate, CreditedChannel
+from .feed import FeedSource, ParallelColumnFeeder
 from .sources import (AsyncGeneratorSource, IngestSourceLogic, ReplaySource,
                       SocketSource)
 
@@ -41,6 +42,7 @@ __all__ = [
     "ADMISSION_POLICIES", "AdmissionConfig", "ShedTuples",
     "StreamDecoder", "decode_batch", "encode_batch",
     "MicrobatchController", "CreditGate", "CreditedChannel",
+    "FeedSource", "ParallelColumnFeeder",
     "AsyncGeneratorSource", "IngestSourceLogic", "ReplaySource",
     "SocketSource",
 ]

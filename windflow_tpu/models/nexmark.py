@@ -76,10 +76,13 @@ def make_q2_selection(auction_ids):
 def build_q5_hot_items(graph, n_bids: int, win_len: int, slide_len: int,
                        sink, n_auctions: int = 1000,
                        batch_size: int = 65_536, device_batch: int = 4096,
-                       parallelism: int = 1, inflight_depth: int = None):
+                       parallelism: int = 1, inflight_depth: int = None,
+                       placement: str = "device"):
     """Q5: per-auction bid counts over sliding time windows.  The
     'hottest item' reduction is the sink's fold (max over each window
-    epoch); the windowed counts are the device-parallel part."""
+    epoch); the windowed counts are the device-parallel part.
+    ``placement`` feeds the cost-based planner (docs/PLANNER.md):
+    'auto' lets it pick the device or host lane per measured costs."""
     import windflow_tpu as wf
     from ..operators.basic_ops import Sink
     from ..operators.batch_ops import BatchSource
@@ -90,7 +93,8 @@ def build_q5_hot_items(graph, n_bids: int, win_len: int, slide_len: int,
                          parallelism=parallelism, batch_len=device_batch,
                          name="q5_counts", emit_batches=True,
                          inflight_depth=(inflight_depth
-                                         or DEFAULT_INFLIGHT_DEPTH))
+                                         or DEFAULT_INFLIGHT_DEPTH),
+                         placement=placement)
     graph.add_source(BatchSource(
         bid_batches(n_bids, batch_size, n_auctions))) \
         .add(counter).add_sink(Sink(sink, name="q5_sink"))
@@ -101,7 +105,8 @@ def build_q7_highest_bid(graph, n_bids: int, win_len: int, sink,
                          n_auctions: int = 1000,
                          batch_size: int = 65_536,
                          device_batch: int = 4096,
-                         inflight_depth: int = None):
+                         inflight_depth: int = None,
+                         placement: str = "device"):
     """Q7: highest price per tumbling window across ALL bids.  Bids are
     funneled onto one key (the reference expresses global windows the
     same way: a single keyed substream), Q1-converted first."""
@@ -121,7 +126,8 @@ def build_q7_highest_bid(graph, n_bids: int, win_len: int, sink,
     from ..operators.tpu.win_seq_tpu import DEFAULT_INFLIGHT_DEPTH
     op = WinSeqTPU("max", win_len, win_len, WinType.TB,
                    batch_len=device_batch, name="q7_max",
-                   inflight_depth=inflight_depth or DEFAULT_INFLIGHT_DEPTH)
+                   inflight_depth=inflight_depth or DEFAULT_INFLIGHT_DEPTH,
+                   placement=placement)
     graph.add_source(BatchSource(
         bid_batches(n_bids, batch_size, n_auctions))) \
         .chain(BatchMap(to_global_key)) \

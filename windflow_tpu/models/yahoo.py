@@ -44,7 +44,8 @@ def build_pipeline(graph, n_events: int, n_ads: int = 1000,
                    n_campaigns: int = 100, win_len: int = 10_000,
                    slide_len: int = 10_000, batch_size: int = 65536,
                    device_batch: int = 4096, sink=None,
-                   source_parallelism: int = 1, key_parallelism: int = 1):
+                   source_parallelism: int = 1, key_parallelism: int = 1,
+                   placement: str = "device"):
     """Wire the Yahoo app into ``graph``; returns the campaign map."""
     import windflow_tpu as wf
     from ..core.tuples import TupleBatch
@@ -88,7 +89,7 @@ def build_pipeline(graph, n_events: int, n_ads: int = 1000,
     counter = KeyFarmTPU(
         "count", win_len, slide_len, wf.WinType.TB,
         parallelism=key_parallelism, batch_len=device_batch,
-        name="campaign_count", emit_batches=True)
+        name="campaign_count", emit_batches=True, placement=placement)
     pipe = graph.add_source(BatchSource(source, source_parallelism))
     pipe.chain(BatchFilter(views_only)) \
         .chain(BatchMap(join_campaign)) \

@@ -11,6 +11,7 @@ bytes staged to device, stats_record.hpp:77-79).
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -46,6 +47,12 @@ class StatsRecord:
     num_launches: int = 0
     bytes_to_device: int = 0
     bytes_from_device: int = 0
+    # per-launch device timing (docs/PLANNER.md): cumulative wall time
+    # from program submit to result-on-host, summed over launches by
+    # the engine's dispatcher.  With the transport RTT floor this
+    # finally separates transport from compute behind the tunnel:
+    # est. transport = launches x floor, est. compute = the rest.
+    device_time_ms: float = 0.0
     # ingest-plane metrics (ingest/; zero outside ingest sources):
     # admission-shed tuples, live credit level, tuples parked in outlet
     # channels, the controller's current coalesced batch size and its
@@ -88,9 +95,29 @@ class StatsRecord:
             "Device_launches": self.num_launches,
             "Bytes_to_device": self.bytes_to_device,
             "Bytes_from_device": self.bytes_from_device,
+            "Device_time_ms": round(self.device_time_ms, 3),
             "Queue_depth": self.queue_depth,
             "Credit_wait_s": round(self.credit_wait_s, 3),
         }
+        if self.num_launches:
+            # per-launch derivations + the roofline estimate: achieved
+            # bytes/s over the launch wall time as a fraction of the
+            # configured peak (WINDFLOW_ROOFLINE_GBPS; an estimate --
+            # wall time includes transport, so this UNDERSTATES the
+            # on-chip HBM fraction and is honest as a lower bound)
+            d["Device_ms_per_launch"] = round(
+                self.device_time_ms / self.num_launches, 3)
+            d["Device_bytes_per_launch"] = int(
+                (self.bytes_to_device + self.bytes_from_device)
+                / self.num_launches)
+            try:
+                peak = float(os.environ.get("WINDFLOW_ROOFLINE_GBPS", "32"))
+            except ValueError:
+                peak = 0.0  # malformed override: omit the estimate
+            if self.device_time_ms > 0 and peak > 0:
+                achieved = (self.bytes_to_device + self.bytes_from_device) \
+                    / (self.device_time_ms / 1e3) / 1e9
+                d["Device_roofline_frac"] = round(achieved / peak, 4)
         if self.ingest_batch_size:     # ingest source replicas only
             d["Ingest_credits"] = self.credits_available
             d["Ingest_queue_depth"] = self.ingest_queue_depth
@@ -126,6 +153,9 @@ class GraphStats:
         # event log surfaced in the JSON
         self.current_parallelism: Dict[str, int] = {}
         self.rescale_events: List[dict] = []
+        # placement planner decisions (graph/planner.py): one entry per
+        # window engine replica, recorded at PipeGraph.start
+        self.placements: List[dict] = []
 
     def register(self, operator_name: str, replica_id: str) -> StatsRecord:
         rec = StatsRecord(operator_name, replica_id)
@@ -141,6 +171,12 @@ class GraphStats:
         """Append a completed RescaleEvent (elastic/rescale.py)."""
         with self.lock:
             self.rescale_events.append(event.to_dict())
+
+    def set_placements(self, decisions: List[dict]) -> None:
+        """Record the planner's per-engine placement decisions
+        (graph/planner.plan_graph)."""
+        with self.lock:
+            self.placements = list(decisions)
 
     def to_json(self, dropped_tuples: int = 0,
                 dead_letter_tuples: int = 0) -> str:
@@ -160,6 +196,7 @@ class GraphStats:
             shed_tuples = sum(r.tuples_shed
                               for rs in self.records.values() for r in rs)
             rescales = list(self.rescale_events)
+            placements = list(self.placements)
         return json.dumps({
             "PipeGraph_name": self.graph_name,
             "Mode": "DEFAULT",
@@ -178,6 +215,10 @@ class GraphStats:
             # old -> new parallelism, trigger signal)
             "Rescales": len(rescales),
             "Rescale_events": rescales,
+            # cost-based placement planner (graph/planner.py;
+            # docs/PLANNER.md): resolved lane + the measured inputs
+            # behind every 'auto' decision
+            "Placements": placements,
             "Memory_usage_KB": get_mem_usage_kb(),
             "Operator_number": len(ops),
             "Operators": ops,

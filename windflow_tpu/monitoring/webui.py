@@ -224,6 +224,7 @@ function opRow(op) {
     <td>${ing}</td>
     <td>${svc.toFixed(1)}</td>
     <td>${fmt(sum("Device_launches"))}</td>
+    <td>${sum("Device_time_ms") ? sum("Device_time_ms").toFixed(0) : "–"}</td>
     <td>${fmt(sum("Bytes_to_device"))}</td>
     <td>${fmt(sum("Bytes_from_device"))}</td></tr>`;
 }
@@ -279,7 +280,8 @@ function render(apps) {
         <th>out</th><th>ignored</th><th>fails</th><th>shed</th>
         <th>q-depth</th><th>cr-wait</th>
         <th>ingest</th><th>svc &micro;s</th>
-        <th>launches</th><th>B&rarr;dev</th><th>B&larr;dev</th></tr>
+        <th>launches</th><th>dev ms</th>
+        <th>B&rarr;dev</th><th>B&larr;dev</th></tr>
       </thead><tbody>${ops.map(opRow).join("")}</tbody></table>
     </div>`;
   }).join("");

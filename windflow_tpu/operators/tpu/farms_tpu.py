@@ -44,7 +44,8 @@ def _tpu_replicas(win_kind, win_len, slide_len, win_type, par, *,
                   farm_kind: str, renumbering=False, emit_batches=False,
                   max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS,
                   inflight_depth=DEFAULT_INFLIGHT_DEPTH,
-                  max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS):
+                  max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS,
+                  placement="device", adaptive_batch=False):
     """Build the worker set with the same config conventions as the CPU
     farms (win_farm.hpp:175 / key_farm worker configs)."""
     reps = []
@@ -69,7 +70,8 @@ def _tpu_replicas(win_kind, win_len, slide_len, win_type, par, *,
             parallelism=par, replica_index=i, renumbering=renumbering,
             value_of=value_of, emit_batches=emit_batches,
             max_buffer_elems=max_buffer_elems, inflight_depth=inflight_depth,
-            max_batch_delay_ms=max_batch_delay_ms))
+            max_batch_delay_ms=max_batch_delay_ms, placement=placement,
+            adaptive_batch=adaptive_batch))
     return reps
 
 
@@ -108,9 +110,12 @@ class KeyFarmTPU(_TPUWinOp):
                  config: WinOperatorConfig = None, emit_batches=False,
                  max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS,
                  coalesce=True, inflight_depth=DEFAULT_INFLIGHT_DEPTH,
-                 max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS):
+                 max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS,
+                 placement="device", adaptive_batch=False):
         super().__init__(name, parallelism, RoutingMode.KEYBY,
                          Pattern.KEY_FARM_TPU, win_type)
+        self.placement = placement
+        self.adaptive_batch = adaptive_batch
         self.args = (win_kind, win_len, slide_len, win_type)
         self.batch_len = batch_len
         self.triggering_delay = triggering_delay
@@ -137,7 +142,8 @@ class KeyFarmTPU(_TPUWinOp):
             renumbering=self._renumbering, emit_batches=self.emit_batches,
             max_buffer_elems=self.max_buffer_elems,
             inflight_depth=self.inflight_depth,
-            max_batch_delay_ms=self.max_batch_delay_ms)
+            max_batch_delay_ms=self.max_batch_delay_ms,
+            placement=self.placement, adaptive_batch=self.adaptive_batch)
         return [StageSpec(self.name, reps, KFEmitter(par),
                           self.routing, ordering_mode=self._ordering())]
 
@@ -151,9 +157,12 @@ class WinFarmTPU(_TPUWinOp):
                  config: WinOperatorConfig = None, role: Role = Role.SEQ,
                  max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS,
                  inflight_depth=DEFAULT_INFLIGHT_DEPTH,
-                 max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS):
+                 max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS,
+                 placement="device", adaptive_batch=False):
         super().__init__(name, parallelism, RoutingMode.COMPLEX,
                          Pattern.WIN_FARM_TPU, win_type)
+        self.placement = placement
+        self.adaptive_batch = adaptive_batch
         self.max_buffer_elems = max_buffer_elems
         self.inflight_depth = inflight_depth
         self.max_batch_delay_ms = max_batch_delay_ms
@@ -177,7 +186,8 @@ class WinFarmTPU(_TPUWinOp):
             enclosing=cfg, role=self.role, farm_kind="wf",
             max_buffer_elems=self.max_buffer_elems,
             inflight_depth=self.inflight_depth,
-            max_batch_delay_ms=self.max_batch_delay_ms)
+            max_batch_delay_ms=self.max_batch_delay_ms,
+            placement=self.placement, adaptive_batch=self.adaptive_batch)
         emitter = WFEmitter(win_len, slide_len, self.parallelism, win_type,
                             self.role, id_outer=cfg.id_inner,
                             n_outer=cfg.n_inner, slide_outer=cfg.slide_inner)
@@ -207,10 +217,13 @@ class PaneFarmTPU(_TPUWinOp):
                  max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS,
                  inflight_depth=DEFAULT_INFLIGHT_DEPTH,
                  max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS,
-                 emit_batches=False):
+                 emit_batches=False, placement="device",
+                 adaptive_batch=False):
         super().__init__(name, plq_parallelism + wlq_parallelism,
                          RoutingMode.COMPLEX, Pattern.PANE_FARM_TPU,
                          win_type)
+        self.placement = placement
+        self.adaptive_batch = adaptive_batch
         if plq_on_tpu == wlq_on_tpu:
             raise ValueError(
                 "exactly one of PLQ/WLQ must run on device "
@@ -272,7 +285,9 @@ class PaneFarmTPU(_TPUWinOp):
             farm_kind="seq", emit_batches=emit_batches,
             max_buffer_elems=self.max_buffer_elems,
             inflight_depth=self.inflight_depth,
-            max_batch_delay_ms=self.max_batch_delay_ms)[0]
+            max_batch_delay_ms=self.max_batch_delay_ms,
+            placement=self.placement,
+            adaptive_batch=self.adaptive_batch)[0]
 
     def _columnar_wlq(self, wlq_win, wlq_slide):
         from .pane_combine import PaneCombineLogic

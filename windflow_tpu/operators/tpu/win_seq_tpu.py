@@ -21,6 +21,7 @@ Win_Seq_GPU does in the reference (win_farm_gpu.hpp:82-86).
 """
 from __future__ import annotations
 
+import threading as _threading
 import time as _time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -39,10 +40,78 @@ from ..base import Operator, StageSpec
 DEFAULT_BATCH_LEN = 256
 # host staging-buffer capacity (elements) before a forced flush
 DEFAULT_MAX_BUFFER_ELEMS = 1 << 19
-# device launches kept in flight before the oldest is flushed
-DEFAULT_INFLIGHT_DEPTH = 4
+# device launches kept in flight before the oldest is flushed.  8 deep
+# (was 4): over a high-latency transport the pipeline must hold enough
+# programs that one RTT amortizes over several launches; the adaptive
+# batch resize below keeps per-launch latency bounded regardless
+DEFAULT_INFLIGHT_DEPTH = 8
 # partial-batch launch trigger (latency bound), milliseconds
 DEFAULT_MAX_BATCH_DELAY_MS = 10.0
+
+PLACEMENTS = ("device", "host", "auto")
+
+
+class AdaptiveBatcher:
+    """x2 / /2 device-batch resize driven by observed launch latency
+    against the measured transport RTT floor -- the adaptation loop of
+    the reference's pinned-buffer management (win_seq_gpu.hpp:574-592),
+    re-aimed at a transport where the launch floor, not buffer size,
+    is the cost.
+
+    * launch latency ~ the floor (<= ``grow_below`` x): the launch is
+      transport-bound -- the batch is too small to amortize the round
+      trip; after ``patience`` consecutive such launches the batch
+      DOUBLES.
+    * launch latency >> the floor (>= ``shrink_above`` x): compute or
+      queueing dominates and per-window latency grows with the batch;
+      after ``patience`` such launches the batch HALVES.
+    * in between: the operating point is good; streaks reset.
+
+    Deterministic on a given latency trace (unit-tested against
+    scripted traces).  The engine reads ``batch_len`` between launches,
+    so resizes take effect on the next batch assembly."""
+
+    __slots__ = ("batch_len", "floor_ms", "lo", "hi", "grow_below",
+                 "shrink_above", "patience", "_grow", "_shrink",
+                 "resizes")
+
+    def __init__(self, batch_len: int, floor_ms: float, lo: int = 64,
+                 hi: int = 1 << 16, grow_below: float = 2.0,
+                 shrink_above: float = 8.0, patience: int = 3):
+        if floor_ms <= 0:
+            raise ValueError("floor_ms must be > 0")
+        # an explicitly configured batch_len outside the default band
+        # widens the band rather than being silently clamped away
+        self.batch_len = max(1, int(batch_len))
+        self.floor_ms = floor_ms
+        self.lo = min(lo, self.batch_len)
+        self.hi = max(hi, self.batch_len)
+        self.grow_below = grow_below
+        self.shrink_above = shrink_above
+        self.patience = patience
+        self._grow = 0
+        self._shrink = 0
+        self.resizes: List = []  # (direction, new_len) decision log
+
+    def observe(self, launch_ms: float) -> int:
+        if launch_ms <= self.grow_below * self.floor_ms:
+            self._grow += 1
+            self._shrink = 0
+            if self._grow >= self.patience and self.batch_len < self.hi:
+                self.batch_len = min(self.hi, self.batch_len * 2)
+                self.resizes.append(("x2", self.batch_len))
+                self._grow = 0
+        elif launch_ms >= self.shrink_above * self.floor_ms:
+            self._shrink += 1
+            self._grow = 0
+            if self._shrink >= self.patience and self.batch_len > self.lo:
+                self.batch_len = max(self.lo, self.batch_len // 2)
+                self.resizes.append(("/2", self.batch_len))
+                self._shrink = 0
+        else:
+            self._grow = 0
+            self._shrink = 0
+        return self.batch_len
 
 
 def _key_groups(keys: np.ndarray):
@@ -151,9 +220,11 @@ class _AsyncDispatcher:
             engine, cols, starts, ends, gwids, descs, birth, emit = item
             last_emit = emit
             try:
+                t_sub = _time.perf_counter()
                 handle = engine.compute(cols, starts, ends, gwids)
                 logic.launched_batches += 1
-                pending.append((handle, descs, birth))
+                pending.append((handle, descs, birth, t_sub,
+                                len(pending) + 1))
                 # flush at depth (backpressure) AND any batch whose
                 # async D2H already landed -- otherwise results wait
                 # for the pipeline to fill and latency grows with
@@ -216,10 +287,32 @@ class WinSeqTPULogic(NodeLogic):
                  max_buffer_elems: int = DEFAULT_MAX_BUFFER_ELEMS,
                  inflight_depth: int = DEFAULT_INFLIGHT_DEPTH,
                  async_dispatch: bool = True,
-                 max_batch_delay_ms: float = DEFAULT_MAX_BATCH_DELAY_MS):
+                 max_batch_delay_ms: float = DEFAULT_MAX_BATCH_DELAY_MS,
+                 placement: str = "device",
+                 adaptive_batch: bool = False,
+                 rtt_floor_ms: Optional[float] = None):
         if win_len == 0 or slide_len == 0:
             raise ValueError("win_len and slide_len must be > 0")
-        self.engine = WindowComputeEngine(win_kind)
+        if placement not in PLACEMENTS:
+            raise ValueError(
+                f"placement must be one of {PLACEMENTS}, not {placement!r}")
+        # placement plane (graph/planner.py; docs/PLANNER.md): 'device'
+        # keeps the XLA lane (status quo), 'host' swaps in the numpy
+        # host engine at construction, 'auto' defers to the cost-based
+        # planner at PipeGraph.start
+        self.placement = placement
+        self.resolved_placement = placement if placement != "auto" else None
+        self.adaptive_batch = adaptive_batch
+        self.rtt_floor_ms = rtt_floor_ms
+        self._adaptive: Optional[AdaptiveBatcher] = None
+        if placement == "host":
+            from ...ops.host_compute import HostComputeEngine
+            self.engine = HostComputeEngine(win_kind)  # builtin kinds only
+        else:
+            self.engine = WindowComputeEngine(win_kind)
+        # direct-feed plane (ingest/feed.py): parallel feeder threads
+        # call feed_columns concurrently; staging is single-writer
+        self._feed_lock = _threading.Lock()
         self.win_len = win_len
         self.slide_len = slide_len
         self.win_type = win_type
@@ -249,6 +342,7 @@ class WinSeqTPULogic(NodeLogic):
         self._dispatcher: Optional[_AsyncDispatcher] = None
         self.ignored_tuples = 0
         self.launched_batches = 0
+        self.last_launch_ms = 0.0  # newest submit->result wall (ms)
         # launch also when this much unshipped data is buffered, even if
         # the window batch is not full -- bounds host memory and keeps
         # device transfers pipelined (the adaptive resize analogue,
@@ -302,6 +396,68 @@ class WinSeqTPULogic(NodeLogic):
             except Exception:
                 self._native = None
 
+    # -- placement plane (graph/planner.py; docs/PLANNER.md) ---------------
+    def apply_placement(self, placement: str,
+                        rtt_floor_ms: Optional[float] = None) -> None:
+        """Resolve this engine onto a lane.  Called by the planner at
+        graph start (before any thread runs) for 'auto' engines, and
+        for pinned ones to record the resolution + RTT floor.  Host
+        resolution swaps the XLA engine for the numpy host engine and
+        drops any cached helper engines so they rebuild on-lane."""
+        from ...ops.host_compute import HostComputeEngine
+        if placement not in ("device", "host"):
+            raise ValueError(f"cannot resolve onto {placement!r}")
+        self.resolved_placement = placement
+        if rtt_floor_ms:
+            self.rtt_floor_ms = rtt_floor_ms
+        if placement == "host" \
+                and not isinstance(self.engine, HostComputeEngine):
+            self.engine = HostComputeEngine(self.engine.kind)
+            for cached in ("_count_eng", "_mean_eng"):
+                if hasattr(self, cached):
+                    delattr(self, cached)
+
+    def _make_engine(self, kind):
+        """Helper-engine factory honouring the resolved lane (the
+        count->sum and mean->pair engines must run where the main
+        engine runs)."""
+        if self.resolved_placement == "host":
+            from ...ops.host_compute import HostComputeEngine
+            return HostComputeEngine(kind)
+        return WindowComputeEngine(kind)
+
+    def svc_init(self) -> None:
+        # adaptive x2 / /2 batch resize (win_seq_gpu.hpp:574-592): only
+        # meaningful against a launch floor, so the device lane measures
+        # one (planner-provided, else probed once per process)
+        if self.adaptive_batch and self._adaptive is None \
+                and self.resolved_placement != "host":
+            if not self.rtt_floor_ms:
+                from ...graph.planner import rtt_floor_ms
+                self.rtt_floor_ms = rtt_floor_ms()
+            self._adaptive = AdaptiveBatcher(self.batch_len,
+                                             self.rtt_floor_ms)
+
+    # -- direct columnar feed (ingest/feed.py) -----------------------------
+    def feed_columns(self, keys, ids, ts, vals, emit) -> None:
+        """Thread-safe columnar ingest for parallel feeder threads:
+        columns go straight into the staging store (the C++ engine when
+        built) under the feed lock -- no channel hop, no per-tuple
+        Python.  ``emit`` receives any results whose launch the ingest
+        triggers (the async dispatcher keeps emitting after return)."""
+        batch = TupleBatch({"key": np.asarray(keys, np.int64),
+                            "id": np.asarray(ids, np.int64),
+                            "ts": np.asarray(ts, np.int64),
+                            "value": np.asarray(vals)})
+        with self._feed_lock:
+            self._svc_batch(batch, emit)
+
+    def feed_eos(self, emit) -> None:
+        """Drain hook for the direct-feed plane (pairs with
+        ``feed_columns`` exactly like the record plane's feed_eos)."""
+        with self._feed_lock:
+            self.eos_flush(emit)
+
     # -- per-key helpers ---------------------------------------------------
     def _key_state(self, key) -> _TPUKeyState:
         st = self.keys.get(key)
@@ -353,14 +509,29 @@ class WinSeqTPULogic(NodeLogic):
 
     # -- batch plane -------------------------------------------------------
     def _finish(self, entry, emit) -> None:
-        """Flush one in-flight batch: block on its handle, sample the
-        window-result latency, emit."""
-        handle, descs, birth = entry
+        """Flush one in-flight batch: block on its handle, record the
+        per-launch device time (submit -> result on host), sample the
+        window-result latency, feed the adaptive batch resize, emit."""
+        handle, descs, birth, t_sub, depth = entry
         results = handle.block()
+        now = _time.perf_counter()
+        launch_ms = (now - t_sub) * 1e3
+        self.last_launch_ms = launch_ms
         if len(self.latency_samples) < 100_000:
-            self.latency_samples.append(_time.perf_counter() - birth)
+            self.latency_samples.append(now - birth)
         if self.stats is not None:  # single-writer: dispatcher thread
             self.stats.bytes_from_device += results.nbytes
+            self.stats.device_time_ms += launch_ms
+        if self._adaptive is not None:
+            # x2 / /2 against the RTT floor; the new length applies to
+            # the next batch assembly (ingest thread reads batch_len).
+            # The wall includes queueing behind the other in-flight
+            # launches on a serialized transport, so it is normalized
+            # by the depth at submit: otherwise a saturated pipeline at
+            # depth 8 always reads >= shrink_above x the floor and the
+            # controller can only shrink under exactly the load it is
+            # meant to optimize
+            self.batch_len = self._adaptive.observe(launch_ms / depth)
         self._emit_results(results, descs, emit)
 
     def _submit(self, cols, starts, ends, gwids, descs, birth, emit,
@@ -381,9 +552,11 @@ class WinSeqTPULogic(NodeLogic):
                 (eng, cols, starts, ends, gwids, descs, birth, emit))
         else:
             self._flush_pending(emit)  # waitAndFlush of the previous
+            t_sub = _time.perf_counter()
             handle = eng.compute(cols, starts, ends, gwids)
             self.launched_batches += 1
-            self.pending.append((handle, descs, birth))
+            self.pending.append((handle, descs, birth, t_sub,
+                                 len(self.pending) + 1))
         self._buffered_since_launch = 0
         self._last_launch_t = _time.perf_counter()
 
@@ -597,7 +770,7 @@ class WinSeqTPULogic(NodeLogic):
     def _count_engine(self):
         # count over panes = sum of per-pane counts
         if not hasattr(self, "_count_eng"):
-            self._count_eng = WindowComputeEngine("sum")
+            self._count_eng = self._make_engine("sum")
         return self._count_eng
 
     # -- descriptor generation (window assignment) -------------------------
@@ -655,7 +828,7 @@ class WinSeqTPULogic(NodeLogic):
 
     def _mean_engine(self):
         if not hasattr(self, "_mean_eng"):
-            self._mean_eng = WindowComputeEngine("mean_panes")
+            self._mean_eng = self._make_engine("mean_panes")
         return self._mean_eng
 
     def _launch_due(self) -> bool:
@@ -950,7 +1123,9 @@ class WinSeqTPU(Operator):
                  max_buffer_elems=DEFAULT_MAX_BUFFER_ELEMS,
                  inflight_depth=DEFAULT_INFLIGHT_DEPTH,
                  async_dispatch=True,
-                 max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS):
+                 max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS,
+                 placement="device", adaptive_batch=False,
+                 rtt_floor_ms=None):
         super().__init__(name, 1, RoutingMode.FORWARD, Pattern.WIN_SEQ_TPU)
         self.win_type = win_type
         self.kwargs = dict(
@@ -960,7 +1135,8 @@ class WinSeqTPU(Operator):
             value_of=value_of, closing_func=closing_func,
             emit_batches=emit_batches, max_buffer_elems=max_buffer_elems,
             inflight_depth=inflight_depth, async_dispatch=async_dispatch,
-            max_batch_delay_ms=max_batch_delay_ms)
+            max_batch_delay_ms=max_batch_delay_ms, placement=placement,
+            adaptive_batch=adaptive_batch, rtt_floor_ms=rtt_floor_ms)
         self._renumbering = False
 
     def enable_renumbering(self):
