@@ -725,6 +725,80 @@ def run_record_chain_host(n_records, opt_level=None):
     return n_records / (time.perf_counter() - t0), count["n"]
 
 
+def run_tracing_overhead(n_events, trace_sample=None, e2e_readout=True):
+    """Config #8: the telemetry-plane overhead gate
+    (docs/OBSERVABILITY.md).  The identical 2f-style materialized feed
+    (template source -> WinSeqTPU sum -> sink) runs twice: telemetry
+    OFF (tracing disabled -- the bitwise status-quo lane every other
+    config measures) and telemetry ON (RuntimeConfig.tracing with the
+    DEFAULT 1-in-N trace sampling: stats records, per-operator latency
+    histograms, sampled end-to-end trace contexts, 1 Hz monitor
+    reporting to the log-dir snapshot fallback).  Reports both rates,
+    the overhead fraction and the traced e2e percentiles.  Acceptance
+    target: overhead < 3% at default sampling (read on a quiet box;
+    this 2-core VM's run-to-run swing exceeds that).
+
+    ``n_events`` is floored so one rep streams for long enough that
+    the traced lane's FIXED per-run costs (monitor thread start, the
+    failed dashboard register, the start/stop snapshot writes --
+    milliseconds, and pre-existing: they ride ``tracing=True``, not
+    the telemetry plane) cannot masquerade as throughput overhead on
+    a short gate-smoke run."""
+    import warnings
+    import windflow_tpu as wf
+    from windflow_tpu.operators.batch_ops import BatchSource
+    from windflow_tpu.operators.basic_ops import Sink
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
+
+    n_events = max(int(n_events), 8_000_000)
+
+    def one(tracing, sample=trace_sample):
+        src = _template_source(n_events, {}, SOURCE_BATCH)
+        cfg = wf.RuntimeConfig(tracing=tracing)
+        if sample is not None:
+            cfg.trace_sample = sample
+        g = wf.PipeGraph("bench8", wf.Mode.DEFAULT, config=cfg)
+        op = WinSeqTPU("sum", WIN, SLIDE, wf.WinType.TB,
+                       batch_len=DEVICE_BATCH, emit_batches=True,
+                       max_buffer_elems=MAX_BUFFER,
+                       inflight_depth=INFLIGHT)
+        sink = _CountSink()
+        g.add_source(BatchSource(src, SOURCE_PARALLELISM)).add(op) \
+            .add_sink(Sink(sink))
+        t0 = time.perf_counter()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # dashboard-less fallback
+            g.run()
+        dt = time.perf_counter() - t0
+        stats = json.loads(g.stats.to_json())
+        return n_events / dt, sink.windows, sink.total, stats
+
+    # interleave off/on and take best-of-3 per lane: the shared box's
+    # swing would otherwise dominate the few-percent signal (and the
+    # first rep eats any residual XLA compile)
+    offs, ons = [], []
+    for _ in range(3):
+        offs.append(one(False))
+        ons.append(one(True))
+    rate_off, w_off, tot_off, _s = max(offs, key=lambda r: r[0])
+    rate_on, w_on, tot_on, _s = max(ons, key=lambda r: r[0])
+    assert w_on == w_off and tot_on == tot_off, \
+        "telemetry sampling changed results"
+    overhead = 1.0 - rate_on / rate_off if rate_off else 0.0
+    # e2e percentile readout from a densely-sampled rep: the feed ships
+    # ~1M-tuple batches, so the DEFAULT 1-in-128 batch sampling sees
+    # almost none of them in a short bench -- the overhead number above
+    # stays at default sampling, the latency numbers trace every batch.
+    # Skippable (e2e_readout=False): callers that only want the on/off
+    # rates (tools/bench_gate.py) should not pay a 7th full run
+    e2e = {}
+    if e2e_readout:
+        _r, w_t, tot_t, stats_t = one(True, sample=1)
+        assert w_t == w_off and tot_t == tot_off
+        e2e = stats_t.get("Latency_e2e") or {}
+    return rate_on, rate_off, overhead, w_on, e2e
+
+
 def run_reference_arch_baseline(n_events):
     """The honest baseline: identical workload through the native C++
     record-at-a-time engine in the reference's architecture (one thread
@@ -975,6 +1049,20 @@ def main():
         "rate": round(r7, 1), "records": c7,
         "rate_unfused": round(r7_0, 1),
         "fused_delta": round(r7 / r7_0, 2)}
+    # telemetry-plane overhead (docs/OBSERVABILITY.md): identical feed
+    # with tracing + default trace sampling ON vs OFF; the acceptance
+    # gate is overhead < 3% at default sampling
+    r8_on, r8_off, ovh, w8, e2e8 = run_tracing_overhead(N_EVENTS // 4)
+    configs["8_tracing_overhead"] = {
+        "rate": round(r8_on, 1), "rate_untraced": round(r8_off, 1),
+        "windows": w8,
+        "overhead_frac": round(ovh, 4),
+        "trace_sample": "default (1/128)",
+        "e2e_p50_ms": (round(e2e8["p50_us"] / 1e3, 2)
+                       if e2e8.get("n") else None),
+        "e2e_p99_ms": (round(e2e8["p99_us"] / 1e3, 2)
+                       if e2e8.get("n") else None),
+        "e2e_traces": e2e8.get("n", 0)}
     for name, c in configs.items():
         n_out = c.get("windows", c.get("records", 0))
         print(f"[bench] {name}: {c['rate']:,.0f} tuples/s "

@@ -62,6 +62,15 @@ def measure() -> dict:
             N_SMALL, feeders=2, placement=lane)
         key = "2j_planner_feed" + ("" if lane == "auto" else f"_{lane}")
         out[key] = round(r, 1)
+    # telemetry-plane smoke (docs/OBSERVABILITY.md): the traced lane
+    # (tracing + default 1/N sampling) must stay within the cliff
+    # threshold -- a regression here means per-item trace stamping
+    # leaked onto the untraced-item hot path.  run_tracing_overhead
+    # itself asserts sampling changed no results.
+    r_on, r_off, _ovh, _w, _e2e = bench.run_tracing_overhead(
+        N_SMALL, e2e_readout=False)
+    out["8_tracing_feed"] = round(r_on, 1)
+    out["8_untraced_feed"] = round(r_off, 1)
     for q in ("q5", "q7"):
         # per-query warmup: each query's engine ('count'/'max') XLA-
         # compiles on first launch; without this the compile lands in

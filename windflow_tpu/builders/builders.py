@@ -193,6 +193,7 @@ class SourceBuilder(_BuilderBase):
         self.admission = None
         self.latency_target_ms = None
         self.initial_batch = None
+        self.trace_sample = None      # None = RuntimeConfig.trace_sample
 
     # -- ingest-plane constructors (windflow_tpu/ingest/) ---------------
     @classmethod
@@ -262,6 +263,20 @@ class SourceBuilder(_BuilderBase):
         self.initial_batch = initial_batch
         return self
 
+    def with_tracing(self, sample_rate: int) -> "SourceBuilder":
+        """Per-source end-to-end latency-tracing period
+        (docs/OBSERVABILITY.md): every ``sample_rate``-th emitted item
+        starts a trace context that rides to the sinks and lands in the
+        per-operator residency and graph e2e histograms.  Overrides
+        ``RuntimeConfig.trace_sample`` for this source; 0 opts this
+        source out of sampling.  Active only under
+        ``RuntimeConfig.tracing``."""
+        sample_rate = int(sample_rate)
+        if sample_rate < 0:
+            raise ValueError("with_tracing: sample_rate must be >= 0")
+        self.trace_sample = sample_rate
+        return self
+
     def with_error_policy(self, policy: str):
         """Sources reject non-default policies loudly: a generation
         loop has no per-tuple svc boundary, so 'skip'/'dead_letter'
@@ -285,8 +300,10 @@ class SourceBuilder(_BuilderBase):
                 raise ValueError(
                     "SourceBuilder needs a generation function, or use "
                     "from_socket/from_replay/from_async (docs/INGEST.md)")
-            return Source(self.fn, self.parallelism, self.name,
-                          self.closing_func)
+            op = Source(self.fn, self.parallelism, self.name,
+                        self.closing_func)
+            op.trace_sample = self.trace_sample
+            return op
         from ..ingest.sources import (AsyncGeneratorSource, ReplaySource,
                                       SocketSource)
         kw = dict(parallelism=self.parallelism, name=self.name,
@@ -295,10 +312,13 @@ class SourceBuilder(_BuilderBase):
                   initial_batch=self.initial_batch,
                   closing_func=self.closing_func)
         if self._ingest_kind == "socket":
-            return SocketSource(**self._ingest_args, **kw)
-        if self._ingest_kind == "replay":
-            return ReplaySource(**self._ingest_args, **kw)
-        return AsyncGeneratorSource(**self._ingest_args, **kw)
+            op = SocketSource(**self._ingest_args, **kw)
+        elif self._ingest_kind == "replay":
+            op = ReplaySource(**self._ingest_args, **kw)
+        else:
+            op = AsyncGeneratorSource(**self._ingest_args, **kw)
+        op.trace_sample = self.trace_sample
+        return op
 
 
 @_alias_camel

@@ -224,6 +224,19 @@ class RuntimeConfig:
     # arena buffers instead of allocating per batch.  False = every
     # batch allocates fresh numpy columns (the pre-pool behaviour).
     buffer_pool: bool = True
+    # -- telemetry plane (telemetry/; docs/OBSERVABILITY.md) ------------
+    # deterministic 1-in-N source sampling period for end-to-end
+    # latency tracing (trace contexts + residency/e2e histograms).
+    # Active only under ``tracing``; 0 keeps the counter surface but
+    # disables every per-item trace stamp (the bitwise-identical
+    # operating point).  Sources can override per operator via
+    # ``SourceBuilder.with_tracing(sample_rate)``.
+    trace_sample: int = 128
+    # bounded structured-event ring (telemetry/recorder.py): rescales,
+    # placements, batch resizes, credit stalls, sheds, svc failures,
+    # checkpoint epochs.  Dumped as JSONL on watchdog stalls and node
+    # failures.  0 disables recording.
+    flight_recorder_events: int = 512
     # -- elastic scaling plane (elastic/; docs/ELASTIC.md) --------------
     # elastic.controller.ElasticityConfig tuning the load-driven
     # controller (sample period, EWMA alpha, cooldown, hysteresis,

@@ -103,7 +103,10 @@ class SynthChunk:
     it transparently at every other plane boundary (RtNode dispatch,
     multi-destination outlets)."""
 
-    __slots__ = ("start", "n", "n_keys", "vmod", "vscale", "voff")
+    # ``trace`` stays UNSET (not None-initialized) so untraced chunks
+    # pay zero construction cost; telemetry reads it via getattr-with-
+    # default (telemetry/trace.py)
+    __slots__ = ("start", "n", "n_keys", "vmod", "vscale", "voff", "trace")
 
     def __init__(self, start, n, n_keys, vmod, vscale, voff):
         self.start = start
@@ -117,13 +120,17 @@ class SynthChunk:
         return self.n
 
     def materialize(self, pool: Optional[ColumnPool] = None) -> "TupleBatch":
+        tr = getattr(self, "trace", None)
         if pool is None:
             idx = self.start + np.arange(self.n)
             ids = idx // self.n_keys
-            return TupleBatch({
+            out = TupleBatch({
                 "key": idx % self.n_keys, "id": ids, "ts": ids,
                 "value": (idx % self.vmod).astype(np.float64) * self.vscale
                          + self.voff})
+            if tr is not None:
+                out.trace = tr
+            return out
         # pooled lane: all columns come from the graph arena;
         # np.ufunc(..., out=) writes them in place (no fresh allocation
         # per chunk)
@@ -137,7 +144,10 @@ class SynthChunk:
                            casting="unsafe")
         if self.voff:
             np.add(vals, self.voff, out=vals)
-        return TupleBatch({"key": keys, "id": ids, "ts": ids, "value": vals})
+        out = TupleBatch({"key": keys, "id": ids, "ts": ids, "value": vals})
+        if tr is not None:
+            out.trace = tr
+        return out
 
 
 class BasicRecord:
@@ -147,7 +157,9 @@ class BasicRecord:
     a library type so users do not have to define one for simple streams.
     """
 
-    __slots__ = ("key", "id", "ts", "value")
+    # ``trace`` stays unset unless the telemetry plane attaches a
+    # context (telemetry/trace.py); no per-record construction cost
+    __slots__ = ("key", "id", "ts", "value", "trace")
 
     def __init__(self, key: Any = 0, tid: int = 0, ts: int = 0, value: float = 0.0):
         self.key = key
@@ -177,7 +189,10 @@ class TupleBatch:
     pinned-buffer batch assembly, win_seq_gpu.hpp:552-596).
     """
 
-    __slots__ = ("cols",)
+    # ``trace`` carries a sampled telemetry TraceContext end to end
+    # (telemetry/trace.py); it stays unset on untraced batches (getattr
+    # default read) so batch construction pays nothing for it
+    __slots__ = ("cols", "trace")
 
     CONTROL = ("key", "id", "ts")
 
@@ -245,9 +260,12 @@ class TupleBatch:
         4-5x faster than boolean fancy indexing repeated per column
         (the filter stages live on this path).  A contiguous index run
         ships as a slice view (zero copies); with ``pool`` the gathered
-        columns reuse arena buffers instead of allocating."""
+        columns reuse arena buffers instead of allocating.  A riding
+        trace context propagates to every sub-batch (KEYBY partitions
+        keep their sampled path traced)."""
         if isinstance(idx, slice):
-            return TupleBatch({k: v[idx] for k, v in self.cols.items()})
+            return self._carry(
+                TupleBatch({k: v[idx] for k, v in self.cols.items()}))
         idx = np.asarray(idx)
         if idx.dtype == np.bool_:
             if len(idx) != len(self):
@@ -263,11 +281,11 @@ class TupleBatch:
             # contiguous ascending run: zero-copy view instead of a
             # gather (the cheap first/last guard gates the O(n) check)
             lo = int(idx[0])
-            return TupleBatch({k: v[lo:lo + n]
-                               for k, v in self.cols.items()})
+            return self._carry(TupleBatch({k: v[lo:lo + n]
+                                           for k, v in self.cols.items()}))
         if pool is None:
-            return TupleBatch({k: np.take(v, idx, axis=0)
-                               for k, v in self.cols.items()})
+            return self._carry(TupleBatch({k: np.take(v, idx, axis=0)
+                                           for k, v in self.cols.items()}))
         out = {}
         for k, v in self.cols.items():
             if v.base is not None and not v.flags.owndata \
@@ -275,17 +293,30 @@ class TupleBatch:
                 out[k] = np.take(v, idx, axis=0)  # odd layout: let numpy
                 continue
             out[k] = np.take(v, idx, axis=0, out=pool.take(n, v.dtype))
-        return TupleBatch(out)
+        return self._carry(TupleBatch(out))
+
+    def _carry(self, out: "TupleBatch") -> "TupleBatch":
+        """Propagate a riding trace context onto a derived batch."""
+        tr = getattr(self, "trace", None)
+        if tr is not None:
+            out.trace = tr
+        return out
 
     def concat(self, other: "TupleBatch") -> "TupleBatch":
-        return TupleBatch(
+        out = TupleBatch(
             {k: np.concatenate([v, other.cols[k]]) for k, v in self.cols.items()}
         )
+        # either side's context rides on (self's stamp wins: it entered
+        # the stream earlier, so the merged batch's latency is honest)
+        tr = getattr(self, "trace", None) or getattr(other, "trace", None)
+        if tr is not None:
+            out.trace = tr
+        return out
 
     def with_cols(self, **cols) -> "TupleBatch":
         out = dict(self.cols)
         out.update(cols)
-        return TupleBatch(out)
+        return self._carry(TupleBatch(out))
 
     def records(self, cls=BasicRecord) -> Iterator[Any]:
         """Materialize records at the API edge (slow path, tests only)."""

@@ -312,6 +312,14 @@ def _grow(graph, handle: ElasticHandle, old_nodes: List[RtNode],
         node.cancel_token = graph._cancel
         node.dead_letters = graph.dead_letters
         node.pool = graph.buffer_pool
+        # telemetry plane: rescale-created replicas trace and record
+        # exactly like start()-wired ones (their stats records pick up
+        # histograms via GraphStats.register's enabled flag)
+        node.flight = graph.flight
+        node.logic.flight = graph.flight
+        if graph.telemetry is not None:
+            node.telemetry = graph.telemetry
+            node.logic.telemetry = graph.telemetry
         if node.pool is not None:
             for o in node.outlets:
                 o.emitter.pool = node.pool

@@ -227,6 +227,10 @@ class MultiPipe:
         stage = source.stages()[0]
         for i, logic in enumerate(stage.replicas):
             node = RtNode(f"{self.name}/{stage.name}", logic, None, [])
+            # per-source trace-sampling override (telemetry/;
+            # SourceBuilder.with_tracing): None defers to
+            # RuntimeConfig.trace_sample, 0 opts out
+            node.trace_sample = getattr(source, "trace_sample", None)
             if self.graph.config.tracing:
                 node.stats = self.graph.stats.register(
                     f"{self.name}/{stage.name}", str(i))

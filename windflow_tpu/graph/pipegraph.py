@@ -55,6 +55,13 @@ class PipeGraph:
         self._cancel = CancelToken()
         self.dead_letters = DeadLetterStore()
         self._watchdog = None
+        # telemetry plane (telemetry/; docs/OBSERVABILITY.md): the
+        # always-on bounded flight recorder (record() no-ops when the
+        # capacity is configured 0) and the tracing hub, built at
+        # start() when tracing sampling is enabled
+        from ..telemetry import FlightRecorder
+        self.flight = FlightRecorder(self.config.flight_recorder_events)
+        self.telemetry = None
         # pooled zero-copy interchange (core/tuples.ColumnPool): one
         # arena per graph, shared by partition sub-batches, SynthChunk
         # materialization and the batched consume loops
@@ -211,6 +218,20 @@ class PipeGraph:
             from ..monitoring.monitor import MonitoringThread
             self._monitor = MonitoringThread(self)
             self._monitor.start()
+        # telemetry hub (telemetry/trace.py): sampled end-to-end
+        # tracing + latency histograms ride the tracing surface;
+        # trace_sample=0 with no per-source with_tracing override keeps
+        # the counter plane with ZERO per-item stamping (node.telemetry
+        # stays None).  A positive per-source override builds the hub
+        # even under a global 0 -- the builder docs promise it wins.
+        if self.config.tracing and (
+                self.config.trace_sample > 0
+                or any((n.trace_sample or 0) > 0
+                       for n in self._all_nodes() if n.channel is None)):
+            from ..telemetry import TelemetryHub
+            self.telemetry = TelemetryHub(self.stats,
+                                          self.config.trace_sample)
+            self.stats.enable_histograms()
         # wire the live-checkpoint pause gate into every source replica
         # and every node (consumer idle ticks pause with the barrier),
         # plus the failure-containment plumbing: the CancelToken learns
@@ -236,6 +257,8 @@ class PipeGraph:
         # (segments carry the engines now), BEFORE any thread starts.
         from .planner import plan_graph
         self.placements = plan_graph(self)
+        for d in self.placements:
+            self.flight.record("placement", **d)
         # attach the column pool to every node and emitter (pooled
         # materialization + partition sub-batches)
         if self.buffer_pool is not None:
@@ -251,15 +274,45 @@ class PipeGraph:
         from ..ingest.wiring import wire_ingest
         wire_ingest(self)
         fault_plan = getattr(self.config, "fault_plan", None)
+        hub = self.telemetry
         for n in self._all_nodes():
             n.pause_ctl = self._pause_ctl
             n.cancel_token = self._cancel
             n.dead_letters = self.dead_letters
+            # telemetry plane: every node/logic learns the flight
+            # recorder; under active tracing sampling the hub is bound
+            # too (source nodes get a deterministic 1-in-N sampler,
+            # consumers stamp hops / close traces)
+            n.flight = self.flight
+            n.logic.flight = self.flight
+            if hub is not None:
+                n.telemetry = hub
+                n.logic.telemetry = hub
+                if n.channel is None:
+                    # per-source builder override (with_tracing): an
+                    # explicit 0 opts this source out, None defers to
+                    # the global period (which may itself be 0)
+                    eff = n.trace_sample \
+                        if n.trace_sample is not None \
+                        else self.config.trace_sample
+                    if eff > 0:
+                        if isinstance(n.logic, FusedLogic):
+                            # fused source head: emissions go segment
+                            # to segment, never through RtNode._emit,
+                            # so the first segment's exit samples
+                            n.logic.trace_sampler = hub.sampler_for(
+                                n.logic.segments[0].name, eff)
+                        else:
+                            n.trace_sampler = hub.sampler_for(
+                                n.name, eff)
             if isinstance(n.logic, FusedLogic):
                 # per-segment identity: dead letters, fault clocks (a
                 # FaultPlan targeting a fused-away operator still fires)
                 for seg in n.logic.segments:
                     seg.dead_letters = self.dead_letters
+                    seg.logic.flight = self.flight
+                    if hub is not None:
+                        seg.logic.telemetry = hub
                     if fault_plan is not None:
                         seg.faults = fault_plan.for_node(seg.name)
             elif fault_plan is not None:
@@ -342,6 +395,13 @@ class PipeGraph:
         if self.config.trace_runtime:
             self._dump_runtime_stats()
         if errors:
+            # post-mortem history first: the flight recorder's last-N
+            # events (rescales, resizes, sheds, svc failures...) next
+            # to the failure that ends the graph
+            self.flight.record(
+                "node_failure", nodes=[name for name, _e in errors],
+                stuck=stuck)
+            self.flight.dump(self.config.log_dir, self.name)
             err = NodeFailureError.from_pairs(errors, stuck)
             raise err from errors[0][1]
         if self._cancel.cancelled:
@@ -511,8 +571,11 @@ class PipeGraph:
             handle = matches[0]
         from ..elastic.rescale import rescale_operator
         with self._rescale_lock:
-            return rescale_operator(self, handle, new_parallelism,
-                                    trigger, timeout)
+            event = rescale_operator(self, handle, new_parallelism,
+                                     trigger, timeout)
+        if event is not None:
+            self.flight.record("rescale", **event.to_dict())
+        return event
 
     def refresh_gauges(self) -> None:
         """Update the per-replica gauge fields of the stats records
@@ -536,7 +599,18 @@ class PipeGraph:
                 rec.queue_depth = ch.depth
             gate = getattr(logic, "gate", None)  # ingest source replicas
             if gate is not None:
-                rec.credit_wait_s = gate.wait_time_s
+                wait = gate.wait_time_s
+                # flight-recorder credit-stall events: one per refresh
+                # interval in which the source spent noticeable time
+                # blocked on credits (>50 ms of new wait since the last
+                # gauge refresh)
+                last = getattr(rec, "_flight_wait_s", 0.0)
+                if wait - last > 0.05:
+                    self.flight.record("credit_stall", node=n.name,
+                                       wait_s=round(wait, 3),
+                                       delta_s=round(wait - last, 3))
+                rec._flight_wait_s = wait
+                rec.credit_wait_s = wait
 
     def live_checkpoint(self, path: str, timeout: float = 120.0) -> int:
         """Mid-stream snapshot: quiesce, save every replica's state
@@ -556,4 +630,6 @@ class PipeGraph:
                     pickle.dump(state, f)
             finally:
                 self.resume()
+        self.flight.record("checkpoint_epoch", path=path,
+                           replicas=len(state))
         return len(state)

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import threading
 import time as _time
+from collections import deque
 from typing import List, Optional, Tuple
 
 DEFAULT_MIN_BATCH = 1 << 10
@@ -69,9 +70,11 @@ class MicrobatchController:
         self._step = max(self.min_batch,
                          (self.max_batch - self.min_batch) // 32)
         # (monotonic time, batch_size) decision trace for the
-        # monitoring JSON / web UI (bounded)
-        self.trace: List[Tuple[float, int]] = [(_time.monotonic(),
-                                                self.batch_size)]
+        # monitoring JSON / web UI: a ROLLING window (maxlen), so a
+        # long-running source keeps its most recent decisions instead
+        # of freezing at the first 4096 (the old append-guard behaviour)
+        self.trace: deque = deque([(_time.monotonic(), self.batch_size)],
+                                  maxlen=4096)
         self.adjustments = 0
 
     # -- signal (called by CreditGate.release, consumer thread) --------
@@ -107,8 +110,7 @@ class MicrobatchController:
                 MAX_FLUSH_MS, self.latency_target_ms * 0.5,
                 self.flush_interval_ms * 1.25)
         self.adjustments += 1
-        if len(self.trace) < 4096:
-            self.trace.append((now, self.batch_size))
+        self.trace.append((now, self.batch_size))
 
     # -- decisions (read by the source / flusher thread) ---------------
     def target_batch(self) -> int:
@@ -138,4 +140,4 @@ class MicrobatchController:
 
     def trace_tail(self, n: int = 32) -> List[Tuple[float, int]]:
         with self._lock:
-            return self.trace[-n:]
+            return list(self.trace)[-n:]

@@ -85,6 +85,10 @@ class IngestSourceLogic(SourceLoopLogic):
         if self.dead_letters is not None:
             self.dead_letters.add(self.node_name, batch,
                                   ShedTuples(policy, n), count=n)
+        if self.flight is not None:  # telemetry flight recorder
+            self.flight.record("shed", node=self.node_name, n=n,
+                               policy=policy,
+                               total=self.tuples_shed)
         if self.stats is not None:
             self.stats.tuples_shed = self.tuples_shed
 

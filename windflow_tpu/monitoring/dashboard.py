@@ -106,9 +106,12 @@ class DashboardServer(threading.Thread):
 
 def serve_http(dash: DashboardServer, port: int = 20208):
     """Expose the dashboard over HTTP: the self-contained HTML
-    front-end at ``/`` (webui.py -- the React-dashboard equivalent) and
-    the JSON state at ``/apps`` (and any other path, kept permissive
-    for curl users)."""
+    front-end at ``/`` (webui.py -- the React-dashboard equivalent),
+    the OpenMetrics text exposition at ``/metrics`` (telemetry/
+    metrics.py -- point a Prometheus scraper here and every traced
+    graph's counters and latency histograms come along) and the JSON
+    state at ``/apps`` (and any other path, kept permissive for curl
+    users)."""
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
@@ -116,6 +119,11 @@ def serve_http(dash: DashboardServer, port: int = 20208):
                 from .webui import HTML_PAGE
                 body = HTML_PAGE.encode()
                 ctype = "text/html; charset=utf-8"
+            elif self.path.split("?", 1)[0] == "/metrics":
+                from ..telemetry.metrics import (CONTENT_TYPE,
+                                                 render_openmetrics)
+                body = render_openmetrics(dash.snapshot()).encode()
+                ctype = CONTENT_TYPE
             else:
                 body = json.dumps(dash.snapshot()).encode()
                 ctype = "application/json"

@@ -18,25 +18,52 @@ the DOT text for the log-dir artifact dump (multipipe.hpp:522-591).
 """
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
+import warnings
+
+
+def _dot_quote(s: str) -> str:
+    """DOT double-quoted-string escaping: a backslash or quote in an
+    operator name must not break the generated graph (graph_to_svg
+    already escapes its XML; this is the DOT twin)."""
+    return s.replace("\\", "\\\\").replace('"', '\\"')
 
 
 def graph_to_dot(graph) -> str:
     """Graphviz description of the PipeGraph topology
     (multipipe.hpp:522-591: vertices per operator, edges labelled by
     routing mode)."""
-    lines = [f'digraph "{graph.name}" {{', "  rankdir=LR;"]
+    lines = [f'digraph "{_dot_quote(graph.name)}" {{', "  rankdir=LR;"]
+    # bare-word node ids (the web UI's parseDot expects \w+), made
+    # collision-free: sanitizing 'op.1' and 'op-1' both to 'op_1'
+    # would otherwise silently merge two operators into one vertex
+    assigned: dict = {}
+    used: set = set()
+
+    def node_id(raw: str) -> str:
+        nid = assigned.get(raw)
+        if nid is None:
+            base = "".join(c if c.isalnum() or c == "_" else "_"
+                           for c in raw)
+            nid, k = base, 2
+            while nid in used:
+                nid = f"{base}_{k}"
+                k += 1
+            used.add(nid)
+            assigned[raw] = nid
+        return nid
+
     for pipe in graph.pipes:
         prev = None
         for name in pipe._op_names:
-            node_id = f"{pipe.name}_{name}".replace("/", "_").replace(
-                "(", "_").replace(")", "_").replace("+", "_")
-            lines.append(f'  {node_id} [label="{name}"];')
+            nid = node_id(f"{pipe.name}_{name}")
+            lines.append(f'  {nid} [label="{_dot_quote(name)}"];')
             if prev is not None:
-                lines.append(f"  {prev} -> {node_id};")
-            prev = node_id
+                lines.append(f"  {prev} -> {nid};")
+            prev = nid
     lines.append("}")
     return "\n".join(lines)
 
@@ -98,6 +125,7 @@ class MonitoringThread(threading.Thread):
         self._stop_evt = threading.Event()
         self.app_id = -1
         self.sock = None
+        self.snapshot_path = None  # set by the dashboard-less fallback
 
     # -- framed protocol ---------------------------------------------------
     def _send_frame(self, *parts: bytes) -> None:
@@ -109,12 +137,26 @@ class MonitoringThread(threading.Thread):
                 (self.machine, self.port), timeout=2.0)
             diagram = graph_to_svg(self.graph).encode()
             self._send_frame(struct.pack("<ii", 0, len(diagram)), diagram)
-            ack = self.sock.recv(4)
+            ack = b""
+            while len(ack) < 4:  # the 4-byte app-id ack may fragment
+                chunk = self.sock.recv(4 - len(ack))
+                if not chunk:
+                    break
+                ack += chunk
             if len(ack) == 4:
                 self.app_id = struct.unpack("<i", ack)[0]
                 return True
         except OSError:
             pass
+        # failure: don't carry a half-registered connection into the
+        # long-lived snapshot fallback (leaked fd + a ghost app on the
+        # dashboard side if the register frame landed)
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
         return False
 
     def _report(self) -> None:
@@ -140,21 +182,80 @@ class MonitoringThread(threading.Thread):
         return "{}"
 
     # -- thread body -------------------------------------------------------
+    def _fallback(self) -> None:
+        """Dashboard unreachable (at registration or mid-run): never
+        silently stop reporting -- drop the socket, warn once per
+        process and switch to periodic log-dir stats-JSON snapshots,
+        so the run is not silently untraced."""
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+        _warn_dashboard_unreachable(self.machine, self.port,
+                                    self.graph.config.log_dir)
+        self._snapshot_loop()
+
     def run(self) -> None:
         if not self._register():
-            return  # dashboard unreachable: tracing silently disabled
-        try:
-            while not self._stop_evt.is_set():
+            self._fallback()
+            return
+        while not self._stop_evt.is_set():
+            try:
                 self._report()
-                self._stop_evt.wait(self.interval_s)
+            except OSError:
+                self._fallback()  # dashboard died mid-run
+                return
+            self._stop_evt.wait(self.interval_s)
+        try:
             self._report()
             self._deregister()
         except OSError:
-            pass
+            pass  # shutdown path: the graph is ending anyway
         finally:
             if self.sock is not None:
                 self.sock.close()
 
+    def _snapshot_loop(self) -> None:
+        """Dashboard-less fallback: refresh + write the stats JSON to
+        ``log_dir/<pid>_<graph>_stats.json`` every reporting interval
+        (atomic rename so a reader never sees a torn file)."""
+        d = self.graph.config.log_dir
+        path = os.path.join(d, f"{os.getpid()}_{self.graph.name}_stats.json")
+        self.snapshot_path = path
+
+        def write():
+            try:
+                os.makedirs(d, exist_ok=True)
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(self._stats_json())
+                os.replace(tmp, path)
+            except OSError:
+                pass  # log dir gone read-only: keep trying, stay alive
+
+        while True:
+            write()
+            if self._stop_evt.wait(self.interval_s):
+                write()  # final state at wait_end
+                return
+
     def stop(self) -> None:
         self._stop_evt.set()
         self.join(timeout=5.0)
+
+
+_dash_warned = False
+
+
+def _warn_dashboard_unreachable(machine: str, port: int,
+                                log_dir: str) -> None:
+    global _dash_warned
+    if _dash_warned:
+        return
+    _dash_warned = True
+    warnings.warn(
+        f"windflow_tpu monitoring: dashboard at {machine}:{port} is "
+        f"unreachable; falling back to periodic stats-JSON snapshots "
+        f"under {log_dir!r}", RuntimeWarning, stacklevel=2)

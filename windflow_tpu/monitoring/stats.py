@@ -14,8 +14,11 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional
+
+from ..telemetry.histogram import LogHistogram
 
 
 @dataclass
@@ -61,7 +64,13 @@ class StatsRecord:
     credits_available: int = 0
     ingest_queue_depth: int = 0
     ingest_batch_size: int = 0
-    controller_trace: list = field(default_factory=list)
+    # DEFENSIVE bound only: the ingest reporter REBINDS this attribute
+    # with the controller's <=32-entry trace tail each report
+    # (ingest/sources.py), and the real rolling bound on long-running
+    # sources lives in MicrobatchController.trace; the deque caps any
+    # direct appender so the record can never become a slow leak
+    controller_trace: deque = field(
+        default_factory=lambda: deque(maxlen=64))
     # standalone gauges refreshed by PipeGraph.refresh_gauges before
     # every report: tuples parked in this replica's inbound channel and
     # cumulative seconds its source gate spent blocked on credits.
@@ -69,11 +78,31 @@ class StatsRecord:
     # elastic signal plane (elastic/signals.py)
     queue_depth: int = 0
     credit_wait_s: float = 0.0
+    # telemetry plane (telemetry/; docs/OBSERVABILITY.md): per-replica
+    # single-writer log-bucketed latency histograms, merged across
+    # replicas at report time.  ``service`` is fed by the sampled
+    # observe() path below; ``residency`` and ``e2e`` by the trace
+    # stamping in the runtime node loop (e2e on sink replicas only,
+    # created lazily at the first trace closure)
+    service_hist: Optional[LogHistogram] = None
+    residency_hist: Optional[LogHistogram] = None
+    e2e_hist: Optional[LogHistogram] = None
+
+    def ensure_hists(self) -> None:
+        """Create the service/residency histograms (idempotent);
+        called when the graph's telemetry plane is enabled."""
+        if self.service_hist is None:
+            self.service_hist = LogHistogram()
+        if self.residency_hist is None:
+            self.residency_hist = LogHistogram()
 
     def observe(self, elapsed_us: float) -> None:
         self.samples += 1
         self.service_time_us += \
             (elapsed_us - self.service_time_us) / self.samples
+        h = self.service_hist
+        if h is not None:
+            h.observe(elapsed_us)
 
     def set_terminated(self) -> None:
         self.terminated = True
@@ -123,7 +152,14 @@ class StatsRecord:
             d["Ingest_queue_depth"] = self.ingest_queue_depth
             d["Ingest_batch_size"] = self.ingest_batch_size
             d["Controller_batch_trace"] = [
-                [round(t, 3), b] for t, b in self.controller_trace[-32:]]
+                [round(t, 3), b]
+                for t, b in list(self.controller_trace)[-32:]]
+        if self.service_hist is not None:
+            lat = {"service": self.service_hist.to_dict(),
+                   "residency": self.residency_hist.to_dict()}
+            if self.e2e_hist is not None:
+                lat["e2e"] = self.e2e_hist.to_dict()
+            d["Latency"] = lat
         return d
 
 
@@ -156,12 +192,42 @@ class GraphStats:
         # placement planner decisions (graph/planner.py): one entry per
         # window engine replica, recorded at PipeGraph.start
         self.placements: List[dict] = []
+        # telemetry plane (telemetry/; docs/OBSERVABILITY.md): once
+        # enabled, every record (existing and future -- rescale-created
+        # replicas register through register()) carries latency
+        # histograms; closed traces land in the bounded recent-record
+        # ring and, when a sink replica has no record, in the graph-
+        # level e2e fallback histogram
+        self.histograms = False
+        self.e2e_extra: Optional[LogHistogram] = None
+        self.trace_records: deque = deque(maxlen=16)
 
     def register(self, operator_name: str, replica_id: str) -> StatsRecord:
         rec = StatsRecord(operator_name, replica_id)
         with self.lock:
+            if self.histograms:
+                rec.ensure_hists()
             self.records.setdefault(operator_name, []).append(rec)
         return rec
+
+    def enable_histograms(self) -> None:
+        """Turn on the latency-histogram surface: backfills every
+        already-registered record and marks future registrations."""
+        with self.lock:
+            self.histograms = True
+            if self.e2e_extra is None:
+                self.e2e_extra = LogHistogram()
+            for replicas in self.records.values():
+                for r in replicas:
+                    r.ensure_hists()
+
+    def add_trace_record(self, rec) -> None:
+        """Append one closed end-to-end trace as a live ``(TraceContext,
+        t_end)`` pair (deque append: no lock).  Serialization happens at
+        report time so hop stamps that land just after closure -- fused
+        upstream segments unwind outward through the closing sink --
+        still make the record."""
+        self.trace_records.append(rec)
 
     def set_parallelism(self, operator_name: str, n: int) -> None:
         with self.lock:
@@ -181,22 +247,47 @@ class GraphStats:
     def to_json(self, dropped_tuples: int = 0,
                 dead_letter_tuples: int = 0) -> str:
         with self.lock:
-            ops = [
-                {
+            ops = []
+            for name, replicas in self.records.items():
+                op = {
                     "Operator_name": name,
                     "Operator_type": name.rsplit("/", 1)[-1],
                     "Parallelism": self.current_parallelism.get(
                         name, len(replicas)),
                     "Replicas": [r.to_dict() for r in replicas],
                 }
-                for name, replicas in self.records.items()
-            ]
+                if self.histograms:
+                    # report-time merge of the per-replica single-writer
+                    # histograms (telemetry/histogram.py)
+                    op["Latency"] = {
+                        "service": LogHistogram.merged(
+                            r.service_hist for r in replicas
+                        ).to_dict(buckets=True),
+                        "residency": LogHistogram.merged(
+                            r.residency_hist for r in replicas
+                        ).to_dict(buckets=True),
+                    }
+                ops.append(op)
             svc_failures = sum(r.svc_failures
                                for rs in self.records.values() for r in rs)
             shed_tuples = sum(r.tuples_shed
                               for rs in self.records.values() for r in rs)
             rescales = list(self.rescale_events)
             placements = list(self.placements)
+            latency_e2e = None
+            trace_records: List[dict] = []
+            if self.histograms:
+                e2e = LogHistogram.merged(
+                    r.e2e_hist for rs in self.records.values() for r in rs)
+                if self.e2e_extra is not None:
+                    e2e.merge_from(self.e2e_extra)
+                latency_e2e = e2e.to_dict(buckets=True)
+                # snapshot FIRST: list(deque) is one C call (atomic
+                # under the GIL), while comprehending over the live
+                # deque would raise 'deque mutated during iteration'
+                # when a sink thread closes a trace mid-report
+                trace_records = [ctx.to_dict(t_end)
+                                 for ctx, t_end in list(self.trace_records)]
         return json.dumps({
             "PipeGraph_name": self.graph_name,
             "Mode": "DEFAULT",
@@ -219,6 +310,13 @@ class GraphStats:
             # docs/PLANNER.md): resolved lane + the measured inputs
             # behind every 'auto' decision
             "Placements": placements,
+            # telemetry plane (telemetry/; docs/OBSERVABILITY.md):
+            # graph-wide end-to-end latency histogram (merged across
+            # sink replicas) and the most recent closed traces with
+            # per-hop stamps; None / absent histograms when tracing
+            # sampling is off
+            "Latency_e2e": latency_e2e,
+            "Trace_records": trace_records,
             "Memory_usage_KB": get_mem_usage_kb(),
             "Operator_number": len(ops),
             "Operators": ops,

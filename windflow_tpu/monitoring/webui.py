@@ -116,8 +116,15 @@ function svgImg(svg) {
 function parseDot(src) {
   const nodes = [], labels = {}, edges = [];
   for (const line of (src || "").split("\\n")) {
-    let m = line.match(/^\\s*(\\w+)\\s*\\[label="([^"]*)"/);
-    if (m) { nodes.push(m[1]); labels[m[1]] = m[2]; continue; }
+    // labels use DOT double-quoted-string escaping (graph_to_dot):
+    // match escaped sequences so a quote in an operator name does not
+    // truncate the label, then unescape for display
+    let m = line.match(/^\\s*(\\w+)\\s*\\[label="((?:[^"\\\\]|\\\\.)*)"/);
+    if (m) {
+      nodes.push(m[1]);
+      labels[m[1]] = m[2].replace(/\\\\(.)/g, "$1");
+      continue;
+    }
     m = line.match(/^\\s*(\\w+)\\s*->\\s*(\\w+)/);
     if (m) edges.push([m[1], m[2]]);
   }
@@ -200,11 +207,21 @@ function hookHover() {
   });
 }
 
+// latency pretty-printer: log-bucketed histogram values in microseconds
+const lus = v => { const n = num(v);
+  return n >= 1e6 ? (n / 1e6).toFixed(2) + "s"
+       : n >= 1e3 ? (n / 1e3).toFixed(1) + "ms" : n.toFixed(0) + "us"; };
+
 function opRow(op) {
   const rs = op.Replicas || [];
   const sum = k => rs.reduce((a, r) => a + num(r[k]), 0);
   const svc = rs.length ?
     rs.reduce((a, r) => a + num(r.Service_time_usec), 0) / rs.length : 0;
+  // telemetry plane: merged per-operator latency histograms
+  const lat = op.Latency || {};
+  const svcH = lat.service || {}, resH = lat.residency || {};
+  const svcP = svcH.n ? `${lus(svcH.p50_us)}/${lus(svcH.p99_us)}` : "–";
+  const resP = resH.n ? lus(resH.p99_us) : "–";
   // ingest replicas report credits / queue depth / controller batch
   // size; other operators render a dash
   const ing = rs.some(r => "Ingest_batch_size" in r) ?
@@ -223,6 +240,8 @@ function opRow(op) {
     <td>${cwait ? cwait.toFixed(1) + "s" : "–"}</td>
     <td>${ing}</td>
     <td>${svc.toFixed(1)}</td>
+    <td>${svcP}</td>
+    <td>${resP}</td>
     <td>${fmt(sum("Device_launches"))}</td>
     <td>${sum("Device_time_ms") ? sum("Device_time_ms").toFixed(0) : "–"}</td>
     <td>${fmt(sum("Bytes_to_device"))}</td>
@@ -273,6 +292,11 @@ function render(apps) {
         <div class="tile"><div class="v">
           ${fmt(num(rep.Memory_usage_KB) * 1024)}B</div>
           <div class="k">resident memory</div></div>
+        ${(rep.Latency_e2e && rep.Latency_e2e.n) ? `<div class="tile">
+          <div class="v">${lus(rep.Latency_e2e.p50_us)} /
+            ${lus(rep.Latency_e2e.p99_us)}</div>
+          <div class="k">e2e latency p50/p99
+            (${fmt(rep.Latency_e2e.n)} traces)</div></div>` : ""}
       </div>
       ${a.diagram.trim().startsWith("<svg") ? svgImg(a.diagram) : topoSvg(parseDot(a.diagram))}
       <div class="spark-wrap">${sparkline(id, hist[id])}</div>
@@ -280,6 +304,7 @@ function render(apps) {
         <th>out</th><th>ignored</th><th>fails</th><th>shed</th>
         <th>q-depth</th><th>cr-wait</th>
         <th>ingest</th><th>svc &micro;s</th>
+        <th>svc p50/p99</th><th>res p99</th>
         <th>launches</th><th>dev ms</th>
         <th>B&rarr;dev</th><th>B&larr;dev</th></tr>
       </thead><tbody>${ops.map(opRow).join("")}</tbody></table>

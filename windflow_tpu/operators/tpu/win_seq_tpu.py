@@ -35,6 +35,7 @@ from ...core import win_assign as wa
 from ...ops.window_compute import WindowComputeEngine
 from ...runtime.emitters import StandardEmitter
 from ...runtime.node import EOSMarker, NodeLogic
+from ...telemetry.profiler import launch_span
 from ..base import Operator, StageSpec
 
 DEFAULT_BATCH_LEN = 256
@@ -221,7 +222,10 @@ class _AsyncDispatcher:
             last_emit = emit
             try:
                 t_sub = _time.perf_counter()
-                handle = engine.compute(cols, starts, ends, gwids)
+                # jax.profiler capture hook (telemetry/profiler.py):
+                # a no-op unless WINDFLOW_JAX_PROFILE=1
+                with launch_span("windflow/window_launch"):
+                    handle = engine.compute(cols, starts, ends, gwids)
                 logic.launched_batches += 1
                 pending.append((handle, descs, birth, t_sub,
                                 len(pending) + 1))
@@ -361,6 +365,14 @@ class WinSeqTPULogic(NodeLogic):
         # feeding the p99 metric of BASELINE.md
         self.latency_samples: List[float] = []
         self._batch_birth: Optional[float] = None
+        # telemetry plane (telemetry/; docs/OBSERVABILITY.md): the
+        # trace context of the most recent traced input crosses the
+        # async dispatcher -- captured at svc, stamped with a device
+        # hop and re-attached to the next finished result batch.  Set
+        # on the ingest thread, consumed on the dispatcher thread:
+        # gauge-grade for sampled traces, like the depth gauges
+        self._trace_ctx = None
+        self._trace_name = "win_seq_tpu"
         # the C++ columnar engine covers the hot standalone cases
         # (native/window_engine.cpp): builtin kinds, identity window
         # assignment, default value column, role SEQ -- or role PLQ,
@@ -427,6 +439,8 @@ class WinSeqTPULogic(NodeLogic):
         return WindowComputeEngine(kind)
 
     def svc_init(self) -> None:
+        if self.stats is not None and self.stats.operator_name:
+            self._trace_name = self.stats.operator_name
         # adaptive x2 / /2 batch resize (win_seq_gpu.hpp:574-592): only
         # meaningful against a launch floor, so the device lane measures
         # one (planner-provided, else probed once per process)
@@ -531,8 +545,22 @@ class WinSeqTPULogic(NodeLogic):
             # depth 8 always reads >= shrink_above x the floor and the
             # controller can only shrink under exactly the load it is
             # meant to optimize
+            before = self.batch_len
             self.batch_len = self._adaptive.observe(launch_ms / depth)
-        self._emit_results(results, descs, emit)
+            if self.batch_len != before and self.flight is not None:
+                self.flight.record("batch_resize",
+                                   operator=self._trace_name,
+                                   old_len=before,
+                                   new_len=self.batch_len,
+                                   launch_ms=round(launch_ms, 3))
+        # trace crossing (telemetry/): the sampled context captured at
+        # svc gets a device hop (submit -> result-on-host) and rides
+        # the result batch to the sink
+        tr = self._trace_ctx
+        if tr is not None:
+            self._trace_ctx = None
+            tr.hop(self._trace_name, t_sub, now)
+        self._emit_results(results, descs, emit, trace=tr)
 
     def _submit(self, cols, starts, ends, gwids, descs, birth, emit,
                 engine=None) -> None:
@@ -553,7 +581,8 @@ class WinSeqTPULogic(NodeLogic):
         else:
             self._flush_pending(emit)  # waitAndFlush of the previous
             t_sub = _time.perf_counter()
-            handle = eng.compute(cols, starts, ends, gwids)
+            with launch_span("windflow/window_launch"):
+                handle = eng.compute(cols, starts, ends, gwids)
             self.launched_batches += 1
             self.pending.append((handle, descs, birth, t_sub,
                                  len(self.pending) + 1))
@@ -606,7 +635,20 @@ class WinSeqTPULogic(NodeLogic):
             self._key_extern[iid] = key
         return iid
 
-    def _emit_results(self, results, descs, emit) -> None:
+    def _emit_results(self, results, descs, emit, trace=None) -> None:
+        if trace is not None:
+            # the captured trace context rides the first emission of
+            # this finished batch to the sink (batch lanes attach to
+            # the whole result batch, record lanes to the first record)
+            def emit(item, _e=emit, _t=trace):
+                nonlocal trace
+                if trace is not None:
+                    trace = None
+                    try:
+                        item.trace = _t
+                    except AttributeError:
+                        pass
+                _e(item)
         if isinstance(descs, tuple) and descs[0] == "native":
             # native-engine batch: columnar descriptor arrays
             _, d_keys, d_gwids, d_rts = descs
@@ -914,6 +956,10 @@ class WinSeqTPULogic(NodeLogic):
             self._launch(emit)
 
     def svc(self, item, channel_id, emit):
+        if self.telemetry is not None:
+            tr = getattr(item, "trace", None)
+            if tr is not None:   # crosses the dispatcher (see _finish)
+                self._trace_ctx = tr
         if isinstance(item, TupleBatch):
             self._svc_batch(item, emit)
             return

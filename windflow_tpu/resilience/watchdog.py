@@ -133,6 +133,15 @@ class StallWatchdog(threading.Thread):
                     self.graph, self.graph.config.log_dir)
             except OSError:
                 self.report_path = None
+            # flight recorder (telemetry/recorder.py): the stall event
+            # plus the last-N-events history next to the channel dump,
+            # so the post-mortem sees what led up to the stall
+            flight = getattr(self.graph, "flight", None)
+            if flight is not None:
+                flight.record("stall", deadline_s=self.deadline_s,
+                              report=self.report_path,
+                              cancelling=self.cancel)
+                flight.dump(self.graph.config.log_dir, self.graph.name)
             if self.cancel:
                 err = StallError(
                     f"graph {self.graph.name!r} made no progress for "

@@ -18,6 +18,7 @@ from typing import Any, Callable, Optional, Sequence
 from ..core.tuples import SynthChunk
 from ..resilience.cancel import GraphCancelled
 from ..resilience.policies import POLICY_DEAD_LETTER, POLICY_FAIL
+from ..telemetry.trace import attach_if_absent
 from .queues import Channel, CHANNEL_TIMEOUT, GET_MANY_MAX
 
 
@@ -36,6 +37,12 @@ class NodeLogic:
     """Base class for operator replica logic."""
 
     stats = None  # replica StatsRecord, attached by RtNode under tracing
+    # telemetry plane (telemetry/): the graph FlightRecorder (always
+    # bound at PipeGraph.start; record() is a no-op when disabled) and,
+    # for logics that stamp trace hops themselves (FusedLogic, the
+    # device window engines), the graph TelemetryHub
+    flight = None
+    telemetry = None
 
     # True (the default) promises every ``emit`` happens before the
     # ``svc``/``eos_flush`` call that received the callback returns.
@@ -226,6 +233,20 @@ class FusedLogic(NodeLogic):
         #                             materialization), set at fuse time
         self._emit_out = None       # the node's outward emit, set per call
         self._obs_left = 1          # sampled whole-chain service timing
+        # trace context inside the chain -- THREAD-LOCAL: in a chain
+        # with an async-emitting segment the dispatcher thread runs
+        # the downstream entries/exits concurrently with the consume
+        # thread, and a shared slot would attach (and double-close)
+        # one thread's in-flight context onto the other's emissions
+        self._live = threading.local()
+        # set by RtNode.run on terminal (outlet-less) nodes: the LAST
+        # segment's entry closes traces, so an async engine segment's
+        # results still measure the device leg before closure
+        self.closes_traces = False
+        # set by PipeGraph.start on fused SOURCE heads: the first
+        # segment's emissions never traverse RtNode._emit, so the
+        # 1-in-N trace sampler runs in the first segment's exit instead
+        self.trace_sampler = None
         self._entry0 = None
         self._exits = None
         self._build_chain()
@@ -242,25 +263,39 @@ class FusedLogic(NodeLogic):
         entry_next = None
         for k in range(n - 1, -1, -1):
             seg = segs[k]
-            exits[k] = self._make_exit(seg, entry_next)
-            entry_next = self._make_entry(seg, exits[k])
+            exits[k] = self._make_exit(seg, entry_next, first=(k == 0))
+            entry_next = self._make_entry(seg, exits[k], first=(k == 0),
+                                          last=(k == n - 1))
         self._exits = exits
         self._entry0 = entry_next
 
-    def _make_exit(self, seg: FusedSegment, entry_next):
+    def _make_exit(self, seg: FusedSegment, entry_next,
+                   first: bool = False):
         if entry_next is None:      # last segment: leave the fused node
             def exit_(item):
                 if seg.faults is not None:
                     seg.faults.before_put()
                 if seg.stats is not None:
                     seg.stats.outputs_sent += 1
+                lc = getattr(self._live, "ctx", None)
+                if lc is not None:
+                    attach_if_absent(item, lc)
                 self._emit_out(item)
         else:
             def exit_(item):
+                if first:
+                    # fused SOURCE head: its emissions never reach
+                    # RtNode._emit, so the 1-in-N sampler runs here
+                    s = self.trace_sampler
+                    if s is not None:
+                        s.maybe_attach(item)
                 if seg.faults is not None:
                     seg.faults.before_put()
                 if seg.stats is not None:
                     seg.stats.outputs_sent += 1
+                lc = getattr(self._live, "ctx", None)
+                if lc is not None:
+                    attach_if_absent(item, lc)
                 try:
                     entry_next(item, 0)
                 except Exception as e:
@@ -270,8 +305,15 @@ class FusedLogic(NodeLogic):
                     raise _FusedDownstreamError(e) from e
         return exit_
 
-    def _make_entry(self, seg: FusedSegment, exit_):
+    def _make_entry(self, seg: FusedSegment, exit_, first: bool = False,
+                    last: bool = False):
         svc = seg.logic.svc
+        # live-context inheritance is SAME-THREAD state: an async-
+        # emitting segment (sync_emit=False, the device dispatcher)
+        # runs exits from its own thread, which must not read the
+        # consume thread's in-flight context (the engine carries its
+        # context across the dispatcher itself -- win_seq_tpu.py)
+        inherit = getattr(seg.logic, "sync_emit", True)
 
         def entry(item, cid):
             if isinstance(item, SynthChunk) and not seg.accepts_chunks:
@@ -284,6 +326,22 @@ class FusedLogic(NodeLogic):
             st = seg.stats
             if st is not None:
                 st.inputs_received += 1
+            # per-segment trace attribution (telemetry/): residency is
+            # a channel property so only the first segment records it;
+            # every segment stamps its own hop.  An inner segment's
+            # hop interval includes its downstream segments' inline
+            # work (documented in docs/OBSERVABILITY.md)
+            ctx = None if self.telemetry is None \
+                else getattr(item, "trace", None)
+            if ctx is not None:
+                t_in = _time.perf_counter()
+                if first and st is not None \
+                        and st.residency_hist is not None:
+                    st.residency_hist.observe((t_in - ctx.last) * 1e6)
+                if inherit:
+                    live = self._live
+                    prev = getattr(live, "ctx", None)
+                    live.ctx = ctx
             try:
                 svc(item, cid, exit_)
             except Exception as e:
@@ -291,9 +349,23 @@ class FusedLogic(NodeLogic):
                     raise
                 if st is not None:
                     st.svc_failures += 1
+                if self.flight is not None:
+                    self.flight.record("svc_failure", node=seg.name,
+                                       error=repr(e))
                 if seg.policy == POLICY_DEAD_LETTER \
                         and seg.dead_letters is not None:
                     seg.dead_letters.add(seg.name, item, e)
+            finally:
+                if ctx is not None:
+                    if inherit:
+                        live.ctx = prev
+                    t_done = _time.perf_counter()
+                    ctx.hop(seg.name, t_in, t_done)
+                    if last and self.closes_traces:
+                        # terminal fused node: the trace ends when the
+                        # item (or an engine result carrying its
+                        # context) reaches the final segment
+                        self.telemetry.close(ctx, st, t_done)
         return entry
 
     # -- NodeLogic surface ----------------------------------------------
@@ -535,8 +607,32 @@ class RtNode(threading.Thread):
         # samples, then 1/16 -- tracing must not cost a perf_counter
         # pair per tuple on the hot path
         self._obs_left = 1
+        # telemetry plane (telemetry/; docs/OBSERVABILITY.md): the
+        # graph TelemetryHub (None = tracing off -> zero per-item
+        # stamping), a TraceSampler on source nodes, the builder's
+        # per-source sample-period override, the graph FlightRecorder,
+        # and the context of the traced item currently inside svc (so
+        # emissions it produces inherit the trace)
+        self.telemetry = None
+        self.trace_sampler = None
+        self.trace_sample = None
+        self.flight = None
+        self._live_trace = None
+        self._terminal = False    # no outlets: traces close here
+        self._fused = False       # FusedLogic: segments stamp their hops
+        self._hop_rec = None      # record taking residency observations
+        self._e2e_rec = None      # record taking e2e closures
 
     def _emit(self, item: Any) -> None:
+        s = self.trace_sampler
+        if s is not None:         # source replica: 1-in-N trace starts
+            s.maybe_attach(item)
+        else:
+            lt = self._live_trace
+            if lt is not None:
+                # a traced input's emissions inherit its context even
+                # when the logic built a fresh item (window results)
+                attach_if_absent(item, lt)
         if self.stats is not None:
             self.stats.outputs_sent += 1
         if self.faults is not None:
@@ -569,6 +665,9 @@ class RtNode(threading.Thread):
                 raise
             if stats is not None:
                 stats.svc_failures += 1
+            if self.flight is not None:
+                self.flight.record("svc_failure", node=self.name,
+                                   error=repr(e))
             if self.error_policy == POLICY_DEAD_LETTER \
                     and self.dead_letters is not None:
                 self.dead_letters.add(self.name, item, e)
@@ -600,6 +699,7 @@ class RtNode(threading.Thread):
         append = buf.append
         stats = self.stats
         svc = self.logic.svc
+        tele = self.telemetry
         processed = 0
         t0 = _time.perf_counter() if stats is not None else 0.0
         try:
@@ -612,16 +712,41 @@ class RtNode(threading.Thread):
                     faults.on_tuple(self.taken)  # may raise
                 if stats is not None:
                     stats.inputs_received += 1
+                ctx = None if tele is None else getattr(item, "trace",
+                                                        None)
+                if ctx is None:
+                    out_cb = append
+                else:
+                    t_in = _time.perf_counter()
+                    rec = self._hop_rec
+                    if rec is not None and rec.residency_hist is not None:
+                        rec.residency_hist.observe(
+                            (t_in - ctx.last) * 1e6)
+
+                    def out_cb(x, _c=ctx):   # emissions inherit ctx
+                        attach_if_absent(x, _c)
+                        append(x)
                 try:
-                    svc(item, cid, append)
+                    svc(item, cid, out_cb)
                 except Exception as e:
                     if self.error_policy == POLICY_FAIL:
                         raise
                     if stats is not None:
                         stats.svc_failures += 1
+                    if self.flight is not None:
+                        self.flight.record("svc_failure", node=self.name,
+                                           error=repr(e))
                     if self.error_policy == POLICY_DEAD_LETTER \
                             and self.dead_letters is not None:
                         self.dead_letters.add(self.name, item, e)
+                if ctx is not None:
+                    t_done = _time.perf_counter()
+                    if not self._fused:
+                        # fused nodes stamp per-SEGMENT hops inline and
+                        # close traces in their last segment's entry
+                        ctx.hop(self.name, t_in, t_done)
+                        if self._terminal:
+                            tele.close(ctx, self._e2e_rec, t_done)
         finally:
             try:
                 if buf:
@@ -643,8 +768,9 @@ class RtNode(threading.Thread):
         get_many = getattr(channel, "get_many", None)
         # buffered emissions require the logic's emits to happen inside
         # the svc call (sync_emit); the async window engines opt out
-        buffered = get_many is not None \
-            and getattr(self.logic, "sync_emit", True)
+        sync_emit = getattr(self.logic, "sync_emit", True)
+        buffered = get_many is not None and sync_emit
+        tele = self.telemetry
         timeout = 0.025 if tick else None
         while True:
             if get_many is not None:
@@ -669,6 +795,21 @@ class RtNode(threading.Thread):
                 self.taken += 1
                 if faults is not None:
                     faults.on_tuple(self.taken)  # may raise InjectedFailure
+                ctx = None if tele is None else getattr(item, "trace",
+                                                        None)
+                if ctx is not None:
+                    t_in = _time.perf_counter()
+                    rec = self._hop_rec
+                    if rec is not None and rec.residency_hist is not None:
+                        rec.residency_hist.observe(
+                            (t_in - ctx.last) * 1e6)
+                    if sync_emit:
+                        # same-thread inheritance only: an async-
+                        # emitting logic's dispatcher thread calls
+                        # _emit concurrently and must not pick up the
+                        # consume thread's in-flight context (the
+                        # engine carries its own across the dispatcher)
+                        self._live_trace = ctx
                 try:
                     self._svc_guarded(item, cid)
                 finally:
@@ -676,12 +817,36 @@ class RtNode(threading.Thread):
                     # barrier's in-flight detection must not see a
                     # skipped tuple as forever in flight
                     self.done += 1
+                    if ctx is not None:
+                        self._live_trace = None
+                        t_done = _time.perf_counter()
+                        if not self._fused:
+                            # fused nodes stamp per-SEGMENT hops inline
+                            # and close traces in their last segment
+                            ctx.hop(self.name, t_in, t_done)
+                            if self._terminal:
+                                tele.close(ctx, self._e2e_rec, t_done)
 
     def run(self) -> None:
         try:
             # logics that track device metrics (launches, staged bytes)
             # write them into the replica's record directly
             self.logic.stats = self.stats
+            # telemetry wiring resolved once per thread, not per item:
+            # fused nodes attribute residency to their first segment and
+            # e2e closures to their last (per-segment records)
+            self._fused = isinstance(self.logic, FusedLogic)
+            if self._fused:
+                # segments observe residency and close traces in their
+                # own entries -- the consume loops must NOT observe too
+                # (it would double-count every traced arrival)
+                self._hop_rec = self._e2e_rec = None
+            else:
+                self._hop_rec = self._e2e_rec = self.stats
+            self._terminal = self.telemetry is not None \
+                and not self.outlets
+            if self._fused:
+                self.logic.closes_traces = self._terminal
             self.logic.svc_init()
             if self.channel is not None:
                 self._consume_loop()

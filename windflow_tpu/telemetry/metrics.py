@@ -1,0 +1,161 @@
+"""OpenMetrics / Prometheus text rendering of the stats-JSON surface
+(docs/OBSERVABILITY.md).
+
+The dashboard server keeps the latest report per registered app (the
+framed TCP protocol, monitoring/dashboard.py); ``render_openmetrics``
+turns that snapshot into the OpenMetrics text exposition served at
+``GET /metrics`` on the existing web-UI HTTP server, so any Prometheus
+scraper pointed at the dashboard sees every traced graph without a new
+agent.  Latency histograms re-expose the log-bucket arrays the
+replicas recorded (telemetry/histogram.py), converted to seconds and
+cumulated into the `le` convention.
+"""
+from __future__ import annotations
+
+from typing import List
+
+CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; " \
+    "charset=utf-8"
+
+
+def _esc(v) -> str:
+    """Escape a label value per the OpenMetrics ABNF."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _labels(**kv) -> str:
+    inner = ",".join(f'{k}="{_esc(v)}"' for k, v in kv.items())
+    return "{" + inner + "}" if inner else ""
+
+
+def _hist_lines(out: List[str], name: str, hist: dict, **labels) -> None:
+    """Emit one histogram family instance from a LogHistogram dict
+    (sparse non-cumulative [le_us, count] pairs; le -1 = +Inf)."""
+    acc = 0
+    saw_inf = False
+    for le_us, count in hist.get("buckets", []):
+        acc += count
+        inf = le_us < 0
+        saw_inf = saw_inf or inf
+        le = "+Inf" if inf else repr(le_us / 1e6)
+        out.append(f"{name}_bucket{_labels(**labels, le=le)} {acc}")
+    n = hist.get("n", 0)
+    if not saw_inf:
+        # the +Inf bucket is mandatory (histogram_quantile returns NaN
+        # without it), and the sparse source only materializes the
+        # overflow bucket for >268 s observations
+        out.append(f"{name}_bucket{_labels(**labels, le='+Inf')} {n}")
+    out.append(f"{name}_count{_labels(**labels)} {n}")
+    out.append(f"{name}_sum{_labels(**labels)} "
+               f"{hist.get('sum_us', 0.0) / 1e6}")
+
+
+_COUNTERS = (
+    # (metric, per-replica stats-JSON field)
+    ("windflow_inputs", "Inputs_received"),
+    ("windflow_outputs", "Outputs_sent"),
+    ("windflow_inputs_ignored", "Inputs_ignored"),
+    ("windflow_svc_failures", "Svc_failures"),
+    ("windflow_shed_tuples", "Shed_tuples"),
+    ("windflow_device_launches", "Device_launches"),
+    ("windflow_device_bytes_to", "Bytes_to_device"),
+    ("windflow_device_bytes_from", "Bytes_from_device"),
+)
+
+
+def render_openmetrics(apps: dict) -> str:
+    """OpenMetrics text for a dashboard snapshot
+    (``DashboardServer.snapshot()``: app id -> {report, active, ...}).
+
+    Emission is FAMILY-major: every sample of a MetricFamily sits
+    contiguously under its ``# TYPE``/``# HELP`` header, across all
+    apps and operators -- the spec requires it, and strict parsers
+    (prometheus_client, promtool) reject interleaved families as a
+    clashing name."""
+    out: List[str] = []
+
+    def family(name, mtype, help_):
+        out.append(f"# TYPE {name} {mtype}")
+        out.append(f"# HELP {name} {help_}")
+
+    reports = [(str(aid), app.get("report"))
+               for aid, app in sorted(apps.items(), key=lambda kv: str(kv[0]))
+               if isinstance(app, dict) and app.get("report")]
+
+    def per_op():
+        for aid, rep in reports:
+            g = rep.get("PipeGraph_name", "")
+            for op in rep.get("Operators", []):
+                yield (op, op.get("Replicas", []),
+                       dict(app=aid, graph=g,
+                            operator=op.get("Operator_name", "")))
+
+    def per_graph():
+        for aid, rep in reports:
+            yield rep, dict(app=aid, graph=rep.get("PipeGraph_name", ""))
+
+    family("windflow_app_active", "gauge",
+           "1 while the graph keeps reporting, 0 after deregistration")
+    for aid, app in sorted(apps.items(), key=lambda kv: str(kv[0])):
+        if not isinstance(app, dict):
+            continue
+        rep = app.get("report") or {}
+        g = rep.get("PipeGraph_name", "")
+        out.append(f"windflow_app_active"
+                   f"{_labels(app=aid, graph=g)} "
+                   f"{1 if app.get('active') else 0}")
+
+    for metric, field in _COUNTERS:
+        family(metric, "counter", f"sum of per-replica {field}")
+        for _op, reps, lab in per_op():
+            out.append(f"{metric}_total{_labels(**lab)} "
+                       f"{sum(int(r.get(field, 0) or 0) for r in reps)}")
+    family("windflow_queue_depth", "gauge",
+           "tuples parked in the operator's inbound channels")
+    for _op, reps, lab in per_op():
+        out.append(f"windflow_queue_depth{_labels(**lab)} "
+                   f"{sum(int(r.get('Queue_depth', 0) or 0) for r in reps)}")
+    family("windflow_parallelism", "gauge", "live replica count")
+    for op, reps, lab in per_op():
+        out.append(f"windflow_parallelism{_labels(**lab)} "
+                   f"{int(op.get('Parallelism', len(reps)) or 0)}")
+    family("windflow_service_time_seconds", "histogram",
+           "sampled per-tuple service time")
+    for op, _reps, lab in per_op():
+        lat = op.get("Latency") or {}
+        if lat.get("service"):
+            _hist_lines(out, "windflow_service_time_seconds",
+                        lat["service"], **lab)
+    family("windflow_channel_residency_seconds", "histogram",
+           "traced channel residency before the operator")
+    for op, _reps, lab in per_op():
+        lat = op.get("Latency") or {}
+        if lat.get("residency"):
+            _hist_lines(out, "windflow_channel_residency_seconds",
+                        lat["residency"], **lab)
+
+    for metric, field, help_ in (
+            ("windflow_dropped_tuples", "Dropped_tuples",
+             "mode-plane drops"),
+            ("windflow_dead_letter_tuples", "Dead_letter_tuples",
+             "tuples quarantined in the dead-letter store"),
+            ("windflow_rescales", "Rescales",
+             "completed runtime rescales")):
+        family(metric, "counter", help_)
+        for rep, lab in per_graph():
+            out.append(f"{metric}_total{_labels(**lab)} "
+                       f"{int(rep.get(field, 0) or 0)}")
+    family("windflow_memory_bytes", "gauge", "process resident memory")
+    for rep, lab in per_graph():
+        out.append(f"windflow_memory_bytes{_labels(**lab)} "
+                   f"{int(rep.get('Memory_usage_KB', 0) or 0) * 1024}")
+    family("windflow_e2e_latency_seconds", "histogram",
+           "traced source-to-sink latency")
+    for rep, lab in per_graph():
+        e2e = rep.get("Latency_e2e")
+        if e2e:
+            _hist_lines(out, "windflow_e2e_latency_seconds", e2e, **lab)
+
+    out.append("# EOF")
+    return "\n".join(out) + "\n"

@@ -1,0 +1,62 @@
+"""Flight recorder: a bounded per-graph ring of structured runtime
+events (docs/OBSERVABILITY.md).
+
+Counters tell an operator *how much*; the flight recorder tells them
+*what happened just before it went wrong*: rescales, placement
+decisions, adaptive-batch resizes, credit stalls, admission sheds, svc
+failures, checkpoint epochs, watchdog stalls.  Events append into a
+``deque(maxlen=N)`` (GIL-atomic, no lock on the hot path) and the ring
+is dumped as JSONL by the stall watchdog and the ``NodeFailureError``
+path in ``PipeGraph.wait_end``, so a post-mortem always has the last N
+events of history even though the process is about to unwind.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import List, Optional
+
+
+class FlightRecorder:
+    """Bounded structured-event ring.  ``record()`` is safe from any
+    thread; ``capacity <= 0`` disables recording entirely."""
+
+    __slots__ = ("_ring", "enabled", "dumped_path")
+
+    def __init__(self, capacity: int = 512):
+        self.enabled = capacity > 0
+        self._ring: deque = deque(maxlen=max(1, capacity))
+        self.dumped_path: Optional[str] = None
+
+    def record(self, kind: str, **fields) -> None:
+        if not self.enabled:
+            return
+        ev = {"t": round(time.time(), 6), "kind": kind}
+        ev.update(fields)
+        self._ring.append(ev)
+
+    def snapshot(self) -> List[dict]:
+        return list(self._ring)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def dump(self, log_dir: str, graph_name: str) -> Optional[str]:
+        """Write the ring as JSONL under ``log_dir``; returns the path
+        (best-effort: an unwritable log dir must not mask the failure
+        being post-mortemed)."""
+        if not self.enabled:
+            return None
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+            path = os.path.join(
+                log_dir, f"{os.getpid()}_{graph_name}_flight.jsonl")
+            with open(path, "w") as f:
+                for ev in self.snapshot():
+                    f.write(json.dumps(ev, default=str) + "\n")
+            self.dumped_path = path
+            return path
+        except OSError:
+            return None
