@@ -799,6 +799,65 @@ def run_tracing_overhead(n_events, trace_sample=None, e2e_readout=True):
     return rate_on, rate_off, overhead, w_on, e2e
 
 
+def run_audit_overhead(n_events):
+    """Config #9: the audit-plane overhead gate (docs/OBSERVABILITY.md
+    "Audit plane").  The identical 2f-style materialized feed (template
+    source -> WinSeqTPU sum -> sink) runs with the flow-conservation
+    auditor ON (RuntimeConfig.audit default: per-delivery ledger books,
+    the periodic auditor thread, frontier tracking, skew census) and
+    OFF (audit=False -- the pre-audit hot path), interleaved best-of-3.
+    The audited lane must (a) produce identical results, (b) report
+    ZERO conservation violations with every edge balanced at the final
+    closure check, and (c) stay within the box's noise band on
+    throughput.  Returns (rate_on, rate_off, overhead_frac, windows,
+    conservation_block)."""
+    import windflow_tpu as wf
+    from windflow_tpu.operators.batch_ops import BatchSource
+    from windflow_tpu.operators.basic_ops import Sink
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
+
+    n_events = max(int(n_events), 8_000_000)
+
+    def one(audit):
+        src = _template_source(n_events, {}, SOURCE_BATCH)
+        cfg = wf.RuntimeConfig(audit=audit)
+        g = wf.PipeGraph("bench9", wf.Mode.DEFAULT, config=cfg)
+        op = WinSeqTPU("sum", WIN, SLIDE, wf.WinType.TB,
+                       batch_len=DEVICE_BATCH, emit_batches=True,
+                       max_buffer_elems=MAX_BUFFER,
+                       inflight_depth=INFLIGHT)
+        sink = _CountSink()
+        g.add_source(BatchSource(src, SOURCE_PARALLELISM)).add(op) \
+            .add_sink(Sink(sink))
+        t0 = time.perf_counter()
+        g.run()
+        dt = time.perf_counter() - t0
+        cons = None
+        if audit:
+            # the wait_end closure check already ran: zero violations
+            # and exactly-balanced books are the acceptance criterion
+            assert g.auditor.violations == [], \
+                f"audit bench violations: {g.auditor.violations}"
+            assert g.auditor.final_done
+            edges = g.auditor.ledger.edges()
+            cons = g.auditor.ledger.conservation_block(
+                edges, g._all_nodes(), g.auditor.violations,
+                g.auditor.passes, g.auditor.final_done)
+            assert all(e["balanced"] for e in cons["Edges"]), cons
+        return n_events / dt, sink.windows, sink.total, cons
+
+    ons, offs = [], []
+    for _ in range(3):
+        offs.append(one(False))
+        ons.append(one(True))
+    rate_off, w_off, tot_off, _c = max(offs, key=lambda r: r[0])
+    rate_on, w_on, tot_on, cons = max(ons, key=lambda r: r[0])
+    assert w_on == w_off and tot_on == tot_off, \
+        "audit plane changed results"
+    overhead = 1.0 - rate_on / rate_off if rate_off else 0.0
+    return rate_on, rate_off, overhead, w_on, cons
+
+
 def run_reference_arch_baseline(n_events):
     """The honest baseline: identical workload through the native C++
     record-at-a-time engine in the reference's architecture (one thread
@@ -1063,6 +1122,19 @@ def main():
         "e2e_p99_ms": (round(e2e8["p99_us"] / 1e3, 2)
                        if e2e8.get("n") else None),
         "e2e_traces": e2e8.get("n", 0)}
+    # audit-plane overhead (docs/OBSERVABILITY.md): identical feed with
+    # the flow-conservation auditor ON (the default) vs OFF; the
+    # audited lane must balance every edge with zero violations and
+    # stay within the box's noise band
+    r9_on, r9_off, ovh9, w9, cons9 = run_audit_overhead(N_EVENTS // 4)
+    configs["9_audit_overhead"] = {
+        "rate": round(r9_on, 1), "rate_unaudited": round(r9_off, 1),
+        "windows": w9,
+        "overhead_frac": round(ovh9, 4),
+        "violations": (cons9 or {}).get("Violations_total", 0),
+        "edges_balanced": (cons9 or {}).get("Edges_balanced"),
+        "edges": (cons9 or {}).get("Edges_total"),
+        "audit_passes": (cons9 or {}).get("Audit_passes")}
     for name, c in configs.items():
         n_out = c.get("windows", c.get("records", 0))
         print(f"[bench] {name}: {c['rate']:,.0f} tuples/s "

@@ -322,6 +322,63 @@ def test_flight_dump_on_fault_plan_crash(tmp_path):
     assert any(e["kind"] == "node_failure" for e in events)
 
 
+def test_flight_kinds_conservation_violation_and_frontier_stall(tmp_path):
+    """Audit-plane flight kinds (audit/; docs/OBSERVABILITY.md): a
+    seeded drop_put lands a ``conservation_violation`` event, a wedged
+    sink lands a ``frontier_stall`` event, and both ride the JSONL
+    dump path."""
+    # conservation_violation: the wait_end closure check flags the
+    # injected drop and dumps the ring as post-mortem evidence
+    plan = FaultPlan().drop_put("map", at_put=10)
+    cfg = RuntimeConfig(fault_plan=plan, log_dir=str(tmp_path),
+                        audit_interval_s=0.05)
+    g = wf.PipeGraph("telem_viol", config=cfg)
+    g.add_source(wf.SourceBuilder(record_source(200)).build()) \
+        .add(wf.MapBuilder(lambda t: t).with_name("map").build()) \
+        .add(wf.MapBuilder(lambda t: t).with_name("fan")
+             .with_parallelism(2).build()) \
+        .add_sink(wf.SinkBuilder(lambda r: None).build())
+    quiet_run(g)
+    evs = g.flight.snapshot()
+    viol = [e for e in evs if e["kind"] == "conservation_violation"]
+    assert viol and viol[0]["violation"] == "lost_delivery"
+    path = g.flight.dumped_path
+    assert path is not None
+    dumped = [json.loads(line) for line in open(path)]
+    assert any(e["kind"] == "conservation_violation" for e in dumped)
+
+    # frontier_stall: a wedged sink freezes its watermark while the
+    # source advances past it
+    release = threading.Event()
+
+    def sticky(rec):
+        if rec is not None and not release.is_set():
+            release.wait(WAIT_S)
+
+    cfg2 = RuntimeConfig(tracing=True, log_dir=str(tmp_path),
+                         audit_interval_s=0.05, frontier_stall_s=0.2)
+    g2 = wf.PipeGraph("telem_stall", config=cfg2)
+    g2.add_source(wf.SourceBuilder(record_source(5000)).build()) \
+        .add(wf.MapBuilder(lambda t: t).with_parallelism(2).build()) \
+        .add_sink(wf.SinkBuilder(sticky).build())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        g2.start()
+        deadline = time.monotonic() + WAIT_S
+        try:
+            while not any(e["kind"] == "frontier_stall"
+                          for e in g2.flight.snapshot()):
+                assert time.monotonic() < deadline, "no stall event"
+                time.sleep(0.02)
+        finally:
+            release.set()
+        g2.wait_end()
+    g2.flight.dump(str(tmp_path), "telem_stall2")
+    dumped = [json.loads(line)
+              for line in open(g2.flight.dumped_path)]
+    assert any(e["kind"] == "frontier_stall" for e in dumped)
+
+
 def test_flight_dump_on_watchdog_stall(tmp_path):
     block = threading.Event()  # never set
 

@@ -71,6 +71,14 @@ def measure() -> dict:
         N_SMALL, e2e_readout=False)
     out["8_tracing_feed"] = round(r_on, 1)
     out["8_untraced_feed"] = round(r_off, 1)
+    # audit-plane smoke (docs/OBSERVABILITY.md): the audited lane (the
+    # DEFAULT operating point: per-delivery ledger books + auditor
+    # thread) must stay within the cliff threshold, and
+    # run_audit_overhead itself asserts zero violations, balanced
+    # edges and identical results
+    r9_on, r9_off, _ovh9, _w9, _cons9 = bench.run_audit_overhead(N_SMALL)
+    out["9_audit_feed"] = round(r9_on, 1)
+    out["9_unaudited_feed"] = round(r9_off, 1)
     for q in ("q5", "q7"):
         # per-query warmup: each query's engine ('count'/'max') XLA-
         # compiles on first launch; without this the compile lands in

@@ -54,6 +54,9 @@ def __getattr__(name):
         "encode_batch": "windflow_tpu.ingest",
         "decode_batch": "windflow_tpu.ingest",
         "StreamDecoder": "windflow_tpu.ingest",
+        # audit plane (audit/; docs/OBSERVABILITY.md "Audit plane")
+        "GraphAuditor": "windflow_tpu.audit",
+        "SpaceSavingSketch": "windflow_tpu.audit",
         # elastic scaling plane (elastic/; docs/ELASTIC.md)
         "ElasticityConfig": "windflow_tpu.elastic",
         "ElasticController": "windflow_tpu.elastic",

@@ -234,9 +234,32 @@ class RuntimeConfig:
     trace_sample: int = 128
     # bounded structured-event ring (telemetry/recorder.py): rescales,
     # placements, batch resizes, credit stalls, sheds, svc failures,
-    # checkpoint epochs.  Dumped as JSONL on watchdog stalls and node
-    # failures.  0 disables recording.
+    # checkpoint epochs, conservation violations, frontier stalls.
+    # Dumped as JSONL on watchdog stalls, node failures and failed
+    # final conservation checks.  0 disables recording.
     flight_recorder_events: int = 512
+    # -- audit plane (audit/; docs/OBSERVABILITY.md) --------------------
+    # online flow-conservation ledger + progress/frontier tracking +
+    # keyed-state census: a GraphAuditor thread proves per-edge
+    # transport conservation while the graph runs (and exactly at
+    # wait_end), publishes per-operator frontiers/lag, and reports key
+    # skew.  False disables the auditor and all per-delivery ledger
+    # accounting (the pre-audit hot path).
+    audit: bool = True
+    # seconds between online audit passes (ledger check + frontier
+    # propagation + census refresh)
+    audit_interval_s: float = 0.25
+    # a pending operator whose frontier does not advance for this long
+    # while upstream frontiers moved is reported as a stalled frontier
+    # (flight-recorder `frontier_stall` + stats flag)
+    frontier_stall_s: float = 5.0
+    # hot-key sketch capacity per KEYBY emitter (space-saving top-K)
+    audit_topk: int = 16
+    # dashboard-less snapshot fallback (monitoring/monitor.py): keep at
+    # most this many *_stats.json snapshot files in log_dir (rotation
+    # deletes the oldest); <= 0 keeps every file (the pre-rotation
+    # behaviour)
+    snapshot_keep: int = 16
     # -- elastic scaling plane (elastic/; docs/ELASTIC.md) --------------
     # elastic.controller.ElasticityConfig tuning the load-driven
     # controller (sample period, EWMA alpha, cooldown, hysteresis,

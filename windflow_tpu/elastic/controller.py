@@ -78,6 +78,11 @@ def decide(report: LoadReport, spec, cfg: ElasticityConfig) \
                f"depth_frac={report.depth_frac:.2f} "
                f"credit_wait={report.credit_wait_frac:.2f} "
                f"rate={report.rate:.0f}/s")
+    if report.skew > 0.0:
+        # audit-plane skew signal: recorded with the decision so an
+        # operator diagnosing a scale-up that did not help can see the
+        # hot key was the bottleneck, not replica count
+        trigger += f" skew={report.skew:.2f}"
     return desired, trigger
 
 

@@ -146,15 +146,22 @@ def _reset_round_robin(emitter, n: int) -> None:
 def _clone_emitter(emitter):
     """Emitter.clone() with the graph ColumnPool detached first: the
     pool holds locks (not deep-copyable) and must be SHARED by the
-    clone, not duplicated."""
+    clone, not duplicated.  Any audit hot-key sketch is detached the
+    same way -- deep-copying it would duplicate the observed counts;
+    the auditor attaches a fresh sketch to the clone instead."""
     pool = getattr(emitter, "pool", None)
+    sketch = getattr(emitter, "key_sketch", None)
     if pool is not None:
         emitter.pool = None
+    if sketch is not None:
+        emitter.key_sketch = None
     try:
         clone = emitter.clone()
     finally:
         if pool is not None:
             emitter.pool = pool
+        if sketch is not None:
+            emitter.key_sketch = sketch
     clone.pool = pool
     return clone
 
@@ -208,6 +215,16 @@ def rescale_operator(graph, handle: ElasticHandle, new_n: int,
             for outlet in handle.outlets:
                 closing.extend(outlet.dests[new_n:])
                 del outlet.dests[new_n:]
+                if outlet.audit_cells is not None:
+                    # audit plane: the trimmed destinations are the
+                    # retiring replicas' (drained) channels -- their
+                    # edges leave the topology with them, but a
+                    # source's deliveries into them stay part of the
+                    # graph-wide Sources_emitted roll-up
+                    if graph.auditor is not None:
+                        graph.auditor.ledger.fold_trimmed(
+                            outlet, outlet.audit_cells[new_n:])
+                    del outlet.audit_cells[new_n:]
                 outlet.emitter.set_n_destinations(new_n)
                 _reset_round_robin(outlet.emitter, new_n)
         retired = old_nodes[new_n:]
@@ -244,6 +261,13 @@ def rescale_operator(graph, handle: ElasticHandle, new_n: int,
             if node.is_alive():
                 raise RescaleError(
                     f"retired replica {node.name!r} failed to unwind")
+            if graph.auditor is not None:
+                # migration accounting: fold the retiring replica's
+                # delivery books into the per-channel retired ledger --
+                # its downstream channels keep cumulative put counts,
+                # so dropping the cells without folding would read as
+                # a permanent duplication on every scale-down
+                graph.auditor.fold_retired(node)
             if node in handle.pipe.nodes:
                 handle.pipe.nodes.remove(node)
             if node.stats is not None:
@@ -296,6 +320,10 @@ def _grow(graph, handle: ElasticHandle, old_nodes: List[RtNode],
             if proxied and gate is not None:
                 ch.bind_gate(pid, gate)
             outlet.dests.append((ch, pid))
+            if outlet.audit_cells is not None:
+                # audit plane: a fresh delivery book per new edge
+                from ..audit import EdgeCell
+                outlet.audit_cells.append(EdgeCell())
         outlet.emitter.set_n_destinations(new_n)
     # downstream wiring: clone replica 0's outlet shape, registering a
     # fresh producer slot per destination channel (EOS accounting on
@@ -325,6 +353,11 @@ def _grow(graph, handle: ElasticHandle, old_nodes: List[RtNode],
                 o.emitter.pool = node.pool
         if fault_plan is not None:
             node.faults = fault_plan.for_node(node.name)
+            node.bind_outlet_faults()
+        if graph.auditor is not None:
+            # audit plane: delivery books + put faults + sketches on
+            # the new replica's own outlets, exactly as at start()
+            graph.auditor.attach_node(node)
         node.stats = graph.stats.register(handle.name, str(idx))
         graph._cancel.register(node.channel)
     handle.pipe.nodes.extend(added)

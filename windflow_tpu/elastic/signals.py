@@ -42,6 +42,12 @@ class LoadReport:
     #                          blocked on credits during the window
     rate: float              # channel items/s entering the operator
     at: float                # monotonic sample time
+    # audit plane (audit/census.py): estimated share of the hottest
+    # key in the operator's KEYBY input stream.  A share near 1.0
+    # means one replica owns the hot key regardless of parallelism --
+    # scaling out cannot relieve it -- so the controller records the
+    # signal with every decision it makes on this operator
+    skew: float = 0.0
 
 
 class OperatorSignals:
@@ -117,6 +123,12 @@ class OperatorSignals:
         # spikes would dominate the EWMA for many windows
         raw = min(raw, 4.0)
         self.util = self.alpha * raw + (1.0 - self.alpha) * self.util
+        # hot-key skew from the audit plane's KEYBY sketches (0.0 when
+        # the auditor is off or the operator is not KEYBY-fed)
+        skew = 0.0
+        auditor = getattr(self.handle.pipe.graph, "auditor", None)
+        if auditor is not None:
+            skew = auditor.skew_of(self.handle.name)
         return LoadReport(
             operator=self.handle.name,
             replicas=len(nodes),
@@ -126,6 +138,7 @@ class OperatorSignals:
             credit_wait_frac=min(d_wait / (dt * max(1, len(gates))), 1.0),
             rate=d_in / dt,
             at=now,
+            skew=skew,
         )
 
 

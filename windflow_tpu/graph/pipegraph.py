@@ -81,6 +81,10 @@ class PipeGraph:
         self.elastic = {}
         self._rescale_lock = threading.Lock()
         self._controller = None
+        # audit plane (audit/; docs/OBSERVABILITY.md): the online
+        # flow-conservation ledger + frontier tracker + skew census
+        # thread, built at start() when RuntimeConfig.audit is on
+        self.auditor = None
 
     # -- construction ------------------------------------------------------
     def _new_pipe(self) -> MultiPipe:
@@ -317,14 +321,30 @@ class PipeGraph:
                         seg.faults = fault_plan.for_node(seg.name)
             elif fault_plan is not None:
                 n.faults = fault_plan.for_node(n.name)
+            if fault_plan is not None:
+                # put-level faults (drop_put/dup_put) act at the
+                # Outlet layer, with or without the audit plane
+                n.bind_outlet_faults()
             if n.channel is not None:
                 self._cancel.register(n.channel)
             if n.channel is None:
                 src = source_loop_of(n.logic)
                 if src is not None:
                     src.pause_control = self._pause_ctl
+        # audit plane (audit/; docs/OBSERVABILITY.md): attach the
+        # per-edge delivery books, outlet put-fault state and KEYBY
+        # hot-key sketches AFTER fusion/ingest wiring and fault binding
+        # (books align with the post-fusion channel set; put faults
+        # bind to the segment whose emissions cross the channel) and
+        # BEFORE any replica thread emits
+        if self.config.audit:
+            from ..audit import GraphAuditor
+            self.auditor = GraphAuditor(self)
+            self.auditor.attach()
         for n in self._all_nodes():
             n.start()
+        if self.auditor is not None:
+            self.auditor.start()
         # watchdog AFTER the replica threads: it treats "no node alive"
         # as graph completion, so starting it first would let it exit
         # before the first node ever ran
@@ -388,6 +408,17 @@ class PipeGraph:
             self._controller.stop()
         if self._watchdog is not None:
             self._watchdog.stop()
+        if self.auditor is not None:
+            # final ledger closure BEFORE the monitor's last snapshot
+            # and the stats dump, so both carry the settled books.
+            # Only a cleanly-ended graph must balance: a failure or
+            # cancellation legitimately strands in-flight tuples.
+            self.auditor.stop()
+            if not errors and not self._cancel.cancelled:
+                final = self.auditor.final_check()
+                if final:
+                    # post-mortem evidence next to the violation events
+                    self.flight.dump(self.config.log_dir, self.name)
         if self._monitor is not None:
             self._monitor.stop()
         if self.config.tracing:
@@ -597,6 +628,10 @@ class PipeGraph:
             ch = n.channel
             if ch is not None:
                 rec.queue_depth = ch.depth
+                # measured since PR 1 on both channel planes
+                # (runtime/queues.py:73 / native.py:209), exported here
+                rec.queue_high_watermark = getattr(ch,
+                                                   "high_watermark", 0)
             gate = getattr(logic, "gate", None)  # ingest source replicas
             if gate is not None:
                 wait = gate.wait_time_s

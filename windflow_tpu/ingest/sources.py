@@ -154,6 +154,22 @@ class IngestSourceLogic(SourceLoopLogic):
         if ts is not None:
             self.transport.load_state(ts)
 
+    # -- audit plane (audit/progress.py): monotone source position ------
+    def progress_frontier(self):
+        """Transport position when the transport keeps one (replay
+        offset, socket bytes decoded into tuples), else the coalescer's
+        cumulative raw-emitted counter -- both monotone, both updated
+        by the replica's own threads (gauge-grade read)."""
+        tp = getattr(self.transport, "position", None)
+        if tp is not None:
+            try:
+                v = tp()
+            except (RuntimeError, TypeError):
+                v = None
+            if v is not None:
+                return v
+        return self.coalescer.raw_emitted
+
     # -- observability ---------------------------------------------------
     def metrics(self) -> dict:
         return {
@@ -236,6 +252,13 @@ class _SocketTransport:
         if self.sock is not None:
             self.sock.close()
             self.sock = None
+
+    def position(self):
+        """Audit frontier: the socket chunk sequence -- frames decoded
+        so far (monotone; decoder counters are single-writer)."""
+        return self.decoder.frames_decoded \
+            if hasattr(self.decoder, "frames_decoded") \
+            else self.bytes_received
 
 
 class _ReplayTransport:
@@ -324,6 +347,11 @@ class _ReplayTransport:
 
     def load_state(self, state) -> None:
         self.off = state["off"]
+
+    def position(self):
+        """Audit frontier: the replay offset (same monotone position
+        the checkpoint plane snapshots)."""
+        return self.off
 
 
 class _AsyncGenTransport:

@@ -220,7 +220,11 @@ class MonitoringThread(threading.Thread):
     def _snapshot_loop(self) -> None:
         """Dashboard-less fallback: refresh + write the stats JSON to
         ``log_dir/<pid>_<graph>_stats.json`` every reporting interval
-        (atomic rename so a reader never sees a torn file)."""
+        (atomic rename so a reader never sees a torn file).  Each run
+        writes ONE file keyed by pid+graph, but successive runs used to
+        accumulate in ``log_dir`` without bound; rotation keeps the
+        newest ``RuntimeConfig.snapshot_keep`` snapshot files (default
+        16; <= 0 disables rotation)."""
         d = self.graph.config.log_dir
         path = os.path.join(d, f"{os.getpid()}_{self.graph.name}_stats.json")
         self.snapshot_path = path
@@ -235,15 +239,49 @@ class MonitoringThread(threading.Thread):
             except OSError:
                 pass  # log dir gone read-only: keep trying, stay alive
 
+        write()
+        rotate_snapshots(d, self.graph.config.snapshot_keep)
         while True:
-            write()
             if self._stop_evt.wait(self.interval_s):
                 write()  # final state at wait_end
                 return
+            write()
 
     def stop(self) -> None:
         self._stop_evt.set()
         self.join(timeout=5.0)
+
+
+def rotate_snapshots(log_dir: str, keep: int) -> None:
+    """Keep-last-N rotation of the snapshot fallback's
+    ``*_stats.json`` files: delete the oldest (by mtime) beyond
+    ``keep``.  Only the snapshot pattern is touched -- flight dumps,
+    stall reports and per-graph log dumps stay.  ``keep <= 0``
+    disables rotation.  Called once when a fallback loop starts (each
+    run writes one new snapshot file, so per-run pruning bounds the
+    directory)."""
+    if keep is None or keep <= 0:
+        return
+    try:
+        names = [n for n in os.listdir(log_dir)
+                 if n.endswith("_stats.json")]
+        if len(names) <= keep:
+            return
+        paths = []
+        for n in names:
+            p = os.path.join(log_dir, n)
+            try:
+                paths.append((os.path.getmtime(p), p))
+            except OSError:
+                continue  # raced with another process's rotation
+        paths.sort()
+        for _mt, p in paths[:max(0, len(paths) - keep)]:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+    except OSError:
+        pass  # unreadable log dir: rotation is best-effort
 
 
 _dash_warned = False

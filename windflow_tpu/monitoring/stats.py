@@ -78,6 +78,14 @@ class StatsRecord:
     # elastic signal plane (elastic/signals.py)
     queue_depth: int = 0
     credit_wait_s: float = 0.0
+    # peak inbound-channel depth, measured by both channel planes since
+    # PR 1 (runtime/queues.py:73 / native.py:209) and exported here
+    queue_high_watermark: int = 0
+    # audit plane (audit/progress.py): the replica's low-watermark
+    # frontier (per-source position units) and how long it has been
+    # held back while work was pending
+    frontier: float = 0.0
+    frontier_lag_ms: float = 0.0
     # telemetry plane (telemetry/; docs/OBSERVABILITY.md): per-replica
     # single-writer log-bucketed latency histograms, merged across
     # replicas at report time.  ``service`` is fed by the sampled
@@ -126,7 +134,10 @@ class StatsRecord:
             "Bytes_from_device": self.bytes_from_device,
             "Device_time_ms": round(self.device_time_ms, 3),
             "Queue_depth": self.queue_depth,
+            "Queue_high_watermark": self.queue_high_watermark,
             "Credit_wait_s": round(self.credit_wait_s, 3),
+            "Frontier": round(self.frontier, 1),
+            "Frontier_lag_ms": round(self.frontier_lag_ms, 1),
         }
         if self.num_launches:
             # per-launch derivations + the roofline estimate: achieved
@@ -201,6 +212,11 @@ class GraphStats:
         self.histograms = False
         self.e2e_extra: Optional[LogHistogram] = None
         self.trace_records: deque = deque(maxlen=16)
+        # audit plane (audit/; docs/OBSERVABILITY.md): the latest
+        # Conservation and Skew blocks, published by the GraphAuditor
+        # after every pass (and after the wait_end final check)
+        self.audit_conservation: Optional[dict] = None
+        self.audit_skew: Optional[dict] = None
 
     def register(self, operator_name: str, replica_id: str) -> StatsRecord:
         rec = StatsRecord(operator_name, replica_id)
@@ -244,6 +260,13 @@ class GraphStats:
         with self.lock:
             self.placements = list(decisions)
 
+    def set_audit(self, conservation: dict, skew: dict) -> None:
+        """Publish the auditor's latest Conservation/Skew blocks
+        (audit/auditor.py)."""
+        with self.lock:
+            self.audit_conservation = conservation
+            self.audit_skew = skew
+
     def to_json(self, dropped_tuples: int = 0,
                 dead_letter_tuples: int = 0) -> str:
         with self.lock:
@@ -274,6 +297,8 @@ class GraphStats:
                               for rs in self.records.values() for r in rs)
             rescales = list(self.rescale_events)
             placements = list(self.placements)
+            conservation = self.audit_conservation
+            skew = self.audit_skew
             latency_e2e = None
             trace_records: List[dict] = []
             if self.histograms:
@@ -310,6 +335,12 @@ class GraphStats:
             # docs/PLANNER.md): resolved lane + the measured inputs
             # behind every 'auto' decision
             "Placements": placements,
+            # audit plane (audit/; docs/OBSERVABILITY.md): the online
+            # flow-conservation ledger (per-edge books + graph-wide
+            # identity inputs + violations) and the keyed-state /
+            # hot-key skew census; None when RuntimeConfig.audit is off
+            "Conservation": conservation,
+            "Skew": skew,
             # telemetry plane (telemetry/; docs/OBSERVABILITY.md):
             # graph-wide end-to-end latency histogram (merged across
             # sink replicas) and the most recent closed traces with

@@ -212,6 +212,35 @@ const lus = v => { const n = num(v);
   return n >= 1e6 ? (n / 1e6).toFixed(2) + "s"
        : n >= 1e3 ? (n / 1e3).toFixed(1) + "ms" : n.toFixed(0) + "us"; };
 
+// audit plane: keyed-state census + hot-key skew (Skew block)
+function skewTable(skew) {
+  if (!skew) return "";
+  const hot = (skew.Hot_keys || []).filter(h => num(h.observed) > 0);
+  const census = (skew.Census || []).filter(c => num(c.keys) > 0);
+  if (!hot.length && !census.length) return "";
+  let s = "";
+  if (hot.length) {
+    s += `<table><thead><tr><th>keyby edge</th><th>hot key</th>
+      <th>share</th><th>est count</th><th>observed</th></tr></thead><tbody>`;
+    for (const h of hot) {
+      const top = (h.top || [])[0] || [];
+      s += `<tr><td>${esc(h.operator)}</td><td>${esc(top[0])}</td>
+        <td>${(num(h.share) * 100).toFixed(1)}%</td>
+        <td>${fmt(top[1])}</td><td>${fmt(h.observed)}</td></tr>`;
+    }
+    s += "</tbody></table>";
+  }
+  if (census.length) {
+    s += `<table><thead><tr><th>keyed state (replica)</th>
+      <th>keys</th><th>est bytes</th></tr></thead><tbody>`;
+    for (const c of census)
+      s += `<tr><td>${esc(c.replica)}</td><td>${fmt(c.keys)}</td>
+        <td>${fmt(c.bytes_est)}B</td></tr>`;
+    s += "</tbody></table>";
+  }
+  return s;
+}
+
 function opRow(op) {
   const rs = op.Replicas || [];
   const sum = k => rs.reduce((a, r) => a + num(r[k]), 0);
@@ -230,6 +259,12 @@ function opRow(op) {
   // standalone load gauges (refresh_gauges): inbound channel depth and
   // credit-wait seconds -- the elastic signal plane's raw inputs
   const cwait = sum("Credit_wait_s");
+  // audit plane: peak inbound depth + the most held-back replica's
+  // frontier lag (0 everywhere = every operator caught up)
+  const hwm = rs.reduce((a, r) =>
+    Math.max(a, num(r.Queue_high_watermark)), 0);
+  const flag = rs.reduce((a, r) =>
+    Math.max(a, num(r.Frontier_lag_ms)), 0);
   return `<tr><td>${esc(op.Operator_name)}</td><td>${num(op.Parallelism)}</td>
     <td>${fmt(sum("Inputs_received"))}</td>
     <td>${fmt(sum("Outputs_sent"))}</td>
@@ -237,6 +272,8 @@ function opRow(op) {
     <td>${fmt(sum("Svc_failures"))}</td>
     <td>${fmt(sum("Shed_tuples"))}</td>
     <td>${fmt(sum("Queue_depth"))}</td>
+    <td>${fmt(hwm)}</td>
+    <td>${flag ? lus(flag * 1e3) : "–"}</td>
     <td>${cwait ? cwait.toFixed(1) + "s" : "–"}</td>
     <td>${ing}</td>
     <td>${svc.toFixed(1)}</td>
@@ -284,6 +321,17 @@ function render(apps) {
           <div class="k">shed tuples (admission)</div></div>
         <div class="tile"><div class="v">${replicas}</div>
           <div class="k">replicas (${num(rep.Operator_number)} ops)</div></div>
+        ${rep.Conservation ? `<div class="tile">
+          <div class="v${num(rep.Conservation.Violations_total)
+            ? " bad" : ""}">
+            ${num(rep.Conservation.Violations_total)
+              ? fmt(rep.Conservation.Violations_total) + " viol."
+              : (rep.Conservation.Edges_balanced
+                 ? "\\u2713 balanced" : "\\u2026 settling")}</div>
+          <div class="k">conservation ledger
+            (${fmt((rep.Conservation.Edges || []).length)} edges,
+            ${fmt(rep.Conservation.Audit_passes || 0)} audits)</div>
+          </div>` : ""}
         <div class="tile"><div class="v">${fmt(rep.Rescales || 0)}</div>
           <div class="k">rescale events${(rep.Rescale_events || []).length
             ? " (last " + esc((e => e.old_parallelism + "\\u2192" +
@@ -302,12 +350,13 @@ function render(apps) {
       <div class="spark-wrap">${sparkline(id, hist[id])}</div>
       <table><thead><tr><th>operator</th><th>par</th><th>in</th>
         <th>out</th><th>ignored</th><th>fails</th><th>shed</th>
-        <th>q-depth</th><th>cr-wait</th>
+        <th>q-depth</th><th>q-hwm</th><th>fr-lag</th><th>cr-wait</th>
         <th>ingest</th><th>svc &micro;s</th>
         <th>svc p50/p99</th><th>res p99</th>
         <th>launches</th><th>dev ms</th>
         <th>B&rarr;dev</th><th>B&larr;dev</th></tr>
       </thead><tbody>${ops.map(opRow).join("")}</tbody></table>
+      ${skewTable(rep.Skew)}
     </div>`;
   }).join("");
   hookHover();

@@ -139,6 +139,22 @@ class AccumulatorLogic(_ReplicaLogic):
     def load_keyed_state(self, kv):
         self.state = dict(kv)
 
+    # -- audit-plane census (audit/census.py): gauge-grade read from
+    # the auditor thread against the LIVE store -- len() is GIL-atomic,
+    # the byte estimate samples one entry (guarded against a racing
+    # resize) ---------------------------------------------------------
+    def keyed_state_census(self):
+        state = self.state
+        n = len(state)
+        if n == 0:
+            return (0, 0)
+        import sys
+        try:
+            per = sys.getsizeof(next(iter(state.values()))) + 64
+        except (RuntimeError, StopIteration):
+            per = 64  # resized under us: count-only estimate
+        return (n, n * per)
+
 
 class SinkLogic(_ReplicaLogic):
     def __init__(self, fn, parallelism, replica_index, closing_func):

@@ -1094,6 +1094,37 @@ class WinSeqTPULogic(NodeLogic):
         self._drain_all(emit)
         return had
 
+    # -- audit-plane hooks (audit/; docs/OBSERVABILITY.md): lock-free
+    # gauge reads from the auditor thread against the live engine -----
+    def audit_in_flight(self) -> dict:
+        """Windows absorbed but not yet emitted: submitted device
+        batches plus the batch under assembly -- the ``in_flight``
+        term of the conservation ledger's device leg."""
+        disp = self._dispatcher
+        pend = len(self.pending) + (disp.depth() if disp is not None
+                                    and hasattr(disp, "depth") else 0)
+        return {"device_batches": pend,
+                "staging": len(self.descriptors)}
+
+    def keyed_state_census(self):
+        """(key count, byte estimate) of the per-key window state.
+        Python path: sampled _TPUKeyState arrays; native path: key
+        count only (the engine owns the buffers)."""
+        if self._native is not None:
+            n = len(self._plq_counters) or len(self._key_intern)
+            return (n, 0) if n else None
+        keys = self.keys
+        n = len(keys)
+        if n == 0:
+            return (0, 0)
+        try:
+            st = next(iter(keys.values()))
+            per = (st.sort_keys.nbytes + st.ts.nbytes
+                   + st.values.nbytes + 96)
+        except (RuntimeError, StopIteration, AttributeError):
+            per = 96  # resized under us: count-only estimate
+        return (n, n * per)
+
     # -- checkpoint / resume (utils/checkpoint.py policy layer) --------
     def state_dict(self):
         """Pickle-friendly snapshot (quiescent contract: no device

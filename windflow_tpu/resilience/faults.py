@@ -11,7 +11,14 @@ front and reproducibly, which faults fire where:
   replica sleeps before every Nth downstream put (seeded jitter),
   simulating a slow consumer / full-channel backpressure window;
 * ``fail_native_build()`` -- the native toolchain probe is forced to
-  fail, exercising the pure-Python fallback (and its warning).
+  fail, exercising the pure-Python fallback (and its warning);
+* ``drop_put(node_substr, at_put)`` / ``dup_put(node_substr, at_put)``
+  -- the matching replica's Nth channel delivery (1-based, counted at
+  the Outlet layer across all destinations) is silently lost "on the
+  wire" / delivered twice.  These simulate transport-plane conservation
+  bugs: the emitted item is counted as intent but never (or doubly)
+  reaches the channel, which the audit plane's flow ledger
+  (audit/ledger.py) must flag as a conservation violation.
 
 Attach a plan via ``RuntimeConfig.fault_plan``; ``PipeGraph.start``
 binds per-node fault state (each node's counters are independent, so a
@@ -85,19 +92,34 @@ class _DelayRule:
         self.jitter_s = jitter_s
 
 
+class _PutRule:
+    """Nth-channel-delivery fault: action in {'drop', 'dup'}."""
+
+    __slots__ = ("node_substr", "at_put", "action")
+
+    def __init__(self, node_substr: str, at_put: int, action: str):
+        self.node_substr = node_substr
+        self.at_put = at_put
+        self.action = action
+
+
 class NodeFaults:
     """Per-replica fault state bound at graph start (own counters +
     own seeded RNG, so injection is deterministic per node)."""
 
-    __slots__ = ("node_name", "crash", "delays", "_rng", "_emits")
+    __slots__ = ("node_name", "crash", "delays", "put_rules", "_rng",
+                 "_emits", "_puts")
 
     def __init__(self, node_name: str, crash: Optional[_CrashRule],
-                 delays: List[_DelayRule], seed: int):
+                 delays: List[_DelayRule], seed: int,
+                 put_rules: Optional[List[_PutRule]] = None):
         self.node_name = node_name
         self.crash = crash
         self.delays = delays
+        self.put_rules = put_rules or []
         self._rng = random.Random((seed, node_name).__repr__())
         self._emits = 0
+        self._puts = 0
 
     def on_tuple(self, taken: int) -> None:
         """Called by the replica loop with its 1-based take counter."""
@@ -115,6 +137,20 @@ class NodeFaults:
                            + (self._rng.random() * d.jitter_s
                               if d.jitter_s else 0.0))
 
+    def put_action(self) -> Optional[str]:
+        """Called by the Outlet layer per channel delivery (after the
+        ledger counted the intent, before the actual ``put``): 'drop'
+        loses the delivery on the wire, 'dup' delivers it twice, None
+        delivers normally.  The counter is per node across all
+        destinations, 1-based like the crash clock."""
+        if not self.put_rules:
+            return None
+        self._puts += 1
+        for r in self.put_rules:
+            if self._puts == r.at_put:
+                return r.action
+        return None
+
 
 class FaultPlan:
     """Seeded, declarative fault schedule for one (test) run."""
@@ -123,6 +159,7 @@ class FaultPlan:
         self.seed = seed
         self._crashes: List[_CrashRule] = []
         self._delays: List[_DelayRule] = []
+        self._put_rules: List[_PutRule] = []
         self._native_armed = False
 
     # -- declaration (chainable) --------------------------------------
@@ -139,6 +176,24 @@ class FaultPlan:
             raise ValueError("every_n must be >= 1")
         self._delays.append(_DelayRule(node_substr, delay_s, every_n,
                                        jitter_s))
+        return self
+
+    def drop_put(self, node_substr: str, at_put: int) -> "FaultPlan":
+        """The matching replica's Nth channel delivery is silently lost
+        between the ledger's intent book and the channel (a simulated
+        transport drop the conservation auditor must flag)."""
+        if at_put < 1:
+            raise ValueError("at_put is 1-based")
+        self._put_rules.append(_PutRule(node_substr, at_put, "drop"))
+        return self
+
+    def dup_put(self, node_substr: str, at_put: int) -> "FaultPlan":
+        """The matching replica's Nth channel delivery is delivered
+        twice (a simulated transport duplication the conservation
+        auditor must flag)."""
+        if at_put < 1:
+            raise ValueError("at_put is 1-based")
+        self._put_rules.append(_PutRule(node_substr, at_put, "dup"))
         return self
 
     def fail_native_build(self) -> "FaultPlan":
@@ -164,9 +219,11 @@ class FaultPlan:
         crash = next((c for c in self._crashes
                       if c.node_substr in node_name), None)
         delays = [d for d in self._delays if d.node_substr in node_name]
-        if crash is None and not delays:
+        puts = [p for p in self._put_rules if p.node_substr in node_name]
+        if crash is None and not delays and not puts:
             return None
-        return NodeFaults(node_name, crash, delays, self.seed)
+        return NodeFaults(node_name, crash, delays, self.seed,
+                          put_rules=puts)
 
     # -- context manager ----------------------------------------------
     def __enter__(self) -> "FaultPlan":

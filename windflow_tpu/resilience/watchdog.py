@@ -39,8 +39,13 @@ def _thread_stacks() -> str:
 
 
 def stall_report(graph) -> dict:
-    """Channel-depth snapshot of every consumer node plus thread stacks."""
+    """Channel-depth snapshot of every consumer node plus thread
+    stacks.  When the audit plane is on (audit/), each row also
+    carries the node's frontier watermark and lag -- the stalled node
+    is usually the one whose frontier froze first."""
     channels = []
+    auditor = getattr(graph, "auditor", None)
+    frontiers = auditor.tracker.frontiers if auditor is not None else {}
     for n in graph._all_nodes():
         ch = n.channel
         row = {
@@ -49,6 +54,11 @@ def stall_report(graph) -> dict:
             "taken": n.taken,
             "done": n.done,
         }
+        fr = frontiers.get(n.name)
+        if fr is not None:
+            row["frontier"] = round(fr["frontier"], 1)
+            row["frontier_lag_ms"] = round(fr["lag_ms"], 1)
+            row["frontier_stalled"] = fr["stalled"]
         if ch is not None:
             row.update({
                 "channel_impl": type(ch).__name__,

@@ -40,7 +40,16 @@ class Emitter:
 
 class StandardEmitter(Emitter):
     """FORWARD round-robin or KEYBY hash routing
-    (standard_emitter.hpp:42-136)."""
+    (standard_emitter.hpp:42-136).
+
+    Audit plane (audit/census.py): when the graph auditor is enabled a
+    space-saving hot-key sketch is attached to every KEYBY instance
+    (``key_sketch``); the batch path offers a sampled per-batch key
+    histogram, the record path a sampled scalar -- the raw input of
+    the Skew table and the elastic controller's skew signal."""
+
+    # attached by audit.GraphAuditor on KEYBY instances; None = off
+    key_sketch = None
 
     def __init__(self, keyed: bool = False,
                  key_of: Callable[[Any], Any] = None):
@@ -50,22 +59,43 @@ class StandardEmitter(Emitter):
 
     def emit(self, item, send_to):
         if self.n_dest == 1:
+            if self.keyed and self.key_sketch is not None:
+                self._observe_keys(item)
             send_to(0, item)
         elif isinstance(item, TupleBatch):
             if not self.keyed:
                 send_to(self._rr, item)  # whole-batch round robin
                 self._rr = (self._rr + 1) % self.n_dest
             else:
+                sk = self.key_sketch
+                if sk is not None:
+                    sk.offer_batch(item.key)
                 # vectorized KEYBY: partition the batch by key hash
                 dests = np.abs(item.key) % self.n_dest
                 for d, sub in partition_batch(item, dests, self.pool):
                     send_to(d, sub)
         elif self.keyed:
             rec = item.record if isinstance(item, EOSMarker) else item
+            sk = self.key_sketch
+            if sk is not None:
+                sk.offer(self.key_of(rec))
             send_to(default_hash(self.key_of(rec)) % self.n_dest, item)
         else:
             send_to(self._rr, item)
             self._rr = (self._rr + 1) % self.n_dest
+
+    def _observe_keys(self, item) -> None:
+        """Single-destination KEYBY: routing is trivial but the skew
+        census still wants the key distribution."""
+        sk = self.key_sketch
+        if isinstance(item, TupleBatch):
+            sk.offer_batch(item.key)
+        else:
+            rec = item.record if isinstance(item, EOSMarker) else item
+            try:
+                sk.offer(self.key_of(rec))
+            except (AttributeError, IndexError, TypeError):
+                pass  # keyless control item
 
     def emit_many(self, items, send_to: SendTo, send_many_to) -> None:
         """Batched-emission plane (Outlet.send_many): route a whole
@@ -75,10 +105,14 @@ class StandardEmitter(Emitter):
         identical to per-item emit."""
         n = self.n_dest
         if n == 1:
+            if self.keyed and self.key_sketch is not None:
+                for item in items:
+                    self._observe_keys(item)
             send_many_to(0, items)
             return
         buckets: dict = {}
         pool = self.pool
+        sk = self.key_sketch if self.keyed else None
         for item in items:
             if isinstance(item, TupleBatch):
                 if not self.keyed:
@@ -86,11 +120,15 @@ class StandardEmitter(Emitter):
                     self._rr = (self._rr + 1) % n
                     buckets.setdefault(d, []).append(item)
                 else:
+                    if sk is not None:
+                        sk.offer_batch(item.key)
                     dests = np.abs(item.key) % n
                     for d, sub in partition_batch(item, dests, pool):
                         buckets.setdefault(int(d), []).append(sub)
             elif self.keyed:
                 rec = item.record if isinstance(item, EOSMarker) else item
+                if sk is not None:
+                    sk.offer(self.key_of(rec))
                 d = default_hash(self.key_of(rec)) % n
                 buckets.setdefault(d, []).append(item)
             else:

@@ -203,7 +203,15 @@ class NativeChannel:
         self.n_producers = 0
         self.capacity = capacity
         self.poisoned = False
-        # raw queue counters (TRACE_FASTFLOW analogue)
+        # raw queue counters (TRACE_FASTFLOW analogue), consumed by
+        # the audit plane's conservation ledger (audit/ledger.py) and
+        # the Queue_high_watermark gauge.  Unlike the pure-Python
+        # channel they are incremented OUTSIDE the C++ ring's lock
+        # (one GIL-held += per successful call): exact under the
+        # single-consumer contract and at quiescent points (the
+        # wait_end closure check), gauge-grade between concurrent
+        # producers mid-stream -- which is why the online dup rule in
+        # the ledger only fires on an inflight-clean snapshot.
         self.puts = 0
         self.gets = 0
         self.high_watermark = 0

@@ -88,6 +88,22 @@ class NodeLogic:
         raise NotImplementedError(
             f"{type(self).__name__} has no keyed state")
 
+    # -- audit-plane hooks (audit/; docs/OBSERVABILITY.md).  Both are
+    # read from the auditor thread against a LIVE replica, so
+    # implementations must be lock-free gauge-grade reads (len() of a
+    # dict, a monotone counter) -- never a full-state iteration --------
+    def keyed_state_census(self):
+        """``(key_count, bytes_estimate)`` for the keyed-state census,
+        or None when this logic holds no keyed state."""
+        return None
+
+    def progress_frontier(self):
+        """Monotone source position (replay offset / synth index /
+        socket chunk seq) for progress tracking; None defers to the
+        generic emitted-items frontier.  Only meaningful on source
+        logics."""
+        return None
+
 
 class ChainedLogic(NodeLogic):
     """Thread fusion of two logics: b consumes a's emissions inline
@@ -472,13 +488,26 @@ def source_loop_of(logic) -> Optional["SourceLoopLogic"]:
 
 class Outlet:
     """Output side of a node: an emitter routing items to destination
-    channels.  ``dests`` is a list of (channel, producer_id)."""
+    channels.  ``dests`` is a list of (channel, producer_id).
 
-    __slots__ = ("emitter", "dests")
+    Audit plane (audit/ledger.py): when the graph auditor is enabled,
+    ``audit_cells`` holds one :class:`~windflow_tpu.audit.EdgeCell` per
+    destination -- the producer-side delivery books (``sent`` counted
+    before the put = intent, ``delivered`` after it returns,
+    ``inflight`` True in between).  Books are written only by the
+    node's single emitting thread (the runtime's emission contract),
+    so plain int adds suffice.  ``faults`` carries the node's
+    put-level fault state (FaultPlan drop_put/dup_put): an injected
+    drop/duplication lands exactly between the two books, which is the
+    divergence the flow-conservation ledger must detect."""
+
+    __slots__ = ("emitter", "dests", "audit_cells", "faults")
 
     def __init__(self, emitter, dests: Sequence):
         self.emitter = emitter
         self.dests = list(dests)
+        self.audit_cells = None
+        self.faults = None
 
     @property
     def n_destinations(self) -> int:
@@ -486,18 +515,54 @@ class Outlet:
 
     def send_to(self, dest_idx: int, item: Any) -> None:
         ch, pid = self.dests[dest_idx]
+        cells = self.audit_cells
+        if cells is None:
+            f = self.faults
+            if f is not None:
+                act = f.put_action()
+                if act is not None:
+                    if act == "drop":
+                        return
+                    ch.put(pid, item)  # dup: deliver twice
+            ch.put(pid, item)
+            return
+        cell = cells[dest_idx]
+        cell.inflight = True
+        cell.sent += 1
+        f = self.faults
+        if f is not None:
+            act = f.put_action()
+            if act is not None:
+                if act == "drop":
+                    # lost on the wire: intent counted, never delivered
+                    cell.inflight = False
+                    return
+                ch.put(pid, item)  # dup: one intent, two deliveries
         ch.put(pid, item)
+        cell.delivered += 1
+        cell.inflight = False
 
     def send_many_to(self, dest_idx: int, items) -> None:
         """Ship a same-destination run of items as one bulk transfer
-        (one channel lock round trip instead of one per item)."""
+        (one channel lock round trip instead of one per item).  Put
+        faults never reach this path: RtNode._flush_emits falls back to
+        per-item sends whenever put-level faults are bound."""
         ch, pid = self.dests[dest_idx]
+        cells = self.audit_cells
+        cell = None
+        if cells is not None:
+            cell = cells[dest_idx]
+            cell.inflight = True
+            cell.sent += len(items)
         pm = getattr(ch, "put_many", None)
         if pm is not None:
             pm(pid, items)
         else:
             for item in items:
                 ch.put(pid, item)
+        if cell is not None:
+            cell.delivered += len(items)
+            cell.inflight = False
 
     def send(self, item: Any) -> None:
         if len(self.dests) > 1 and isinstance(item, SynthChunk):
@@ -622,6 +687,24 @@ class RtNode(threading.Thread):
         self._fused = False       # FusedLogic: segments stamp their hops
         self._hop_rec = None      # record taking residency observations
         self._e2e_rec = None      # record taking e2e closures
+        # outlet-level put faults (drop_put/dup_put): resolved once per
+        # thread in run(); forces the per-item emission fallback
+        self._outlet_put_faults = False
+
+    def bind_outlet_faults(self) -> None:
+        """Propagate put-level fault state (FaultPlan drop_put /
+        dup_put) to the Outlet layer, where channel deliveries happen.
+        Fused nodes bind the LAST segment's faults -- the operator
+        whose emissions actually cross the channel.  Called by
+        PipeGraph.start and the elastic rescale after per-node fault
+        binding; independent of the audit plane, so an injected
+        transport fault fires with or without the ledger books."""
+        f = self.faults
+        if isinstance(self.logic, FusedLogic):
+            f = self.logic.segments[-1].faults
+        if f is not None and f.put_rules:
+            for o in self.outlets:
+                o.faults = f
 
     def _emit(self, item: Any) -> None:
         s = self.trace_sampler
@@ -678,8 +761,11 @@ class RtNode(threading.Thread):
         path: a put-targeted fault must interleave its clock with the
         actual deliveries (crash at tick k delivers exactly the k-1
         item prefix, as at LEVEL0) -- batching the ticks ahead of the
-        sends would lose the whole batch instead."""
-        if self.faults is not None:
+        sends would lose the whole batch instead.  Outlet-level put
+        faults (drop_put/dup_put, bound per outlet even when the node
+        itself carries none -- fused nodes) force the same fallback so
+        the per-delivery fault clock stays exact."""
+        if self.faults is not None or self._outlet_put_faults:
             for item in buf:
                 self._emit(item)
             return
@@ -845,6 +931,8 @@ class RtNode(threading.Thread):
                 self._hop_rec = self._e2e_rec = self.stats
             self._terminal = self.telemetry is not None \
                 and not self.outlets
+            self._outlet_put_faults = any(o.faults is not None
+                                          for o in self.outlets)
             if self._fused:
                 self.logic.closes_traces = self._terminal
             self.logic.svc_init()

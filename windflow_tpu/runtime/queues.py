@@ -66,8 +66,15 @@ class Channel:
         # which this class replaced
         self.capacity = capacity if capacity > 0 else None
         self.poisoned = False
-        # raw queue counters (TRACE_FASTFLOW analogue); tracing-grade
-        # under concurrent producers
+        # raw queue counters (TRACE_FASTFLOW analogue).  Since the
+        # audit plane (audit/ledger.py) these are LOAD-BEARING: the
+        # flow-conservation ledger compares ``puts`` against the
+        # Outlet-layer delivery books and ``gets + depth`` against
+        # ``puts`` at the wait_end closure check.  All three are
+        # updated inside the channel's critical section, so they are
+        # exact (not merely tracing-grade) on this plane; EOS tokens
+        # are counted by neither.  ``high_watermark`` is exported as
+        # the Queue_high_watermark gauge (PipeGraph.refresh_gauges).
         self.puts = 0
         self.gets = 0
         self.high_watermark = 0

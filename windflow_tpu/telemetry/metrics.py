@@ -116,6 +116,29 @@ def render_openmetrics(apps: dict) -> str:
     for _op, reps, lab in per_op():
         out.append(f"windflow_queue_depth{_labels(**lab)} "
                    f"{sum(int(r.get('Queue_depth', 0) or 0) for r in reps)}")
+    family("windflow_queue_high_watermark", "gauge",
+           "peak depth of the operator's inbound channels")
+    for _op, reps, lab in per_op():
+        hwm = max((int(r.get("Queue_high_watermark", 0) or 0)
+                   for r in reps), default=0)
+        out.append(f"windflow_queue_high_watermark{_labels(**lab)} {hwm}")
+    # audit plane (audit/; docs/OBSERVABILITY.md): frontier gauges per
+    # operator (max over replicas = the most advanced replica; lag is
+    # the max = the most held-back one)
+    family("windflow_frontier", "gauge",
+           "low-watermark progress frontier (per-source position units)")
+    for _op, reps, lab in per_op():
+        fr = max((float(r.get("Frontier", 0) or 0) for r in reps),
+                 default=0.0)
+        out.append(f"windflow_frontier{_labels(**lab)} {fr}")
+    family("windflow_frontier_lag_seconds", "gauge",
+           "how long the operator's frontier has been held while work "
+           "was pending")
+    for _op, reps, lab in per_op():
+        lag = max((float(r.get("Frontier_lag_ms", 0) or 0)
+                   for r in reps), default=0.0)
+        out.append(f"windflow_frontier_lag_seconds{_labels(**lab)} "
+                   f"{lag / 1e3}")
     family("windflow_parallelism", "gauge", "live replica count")
     for op, reps, lab in per_op():
         out.append(f"windflow_parallelism{_labels(**lab)} "
@@ -150,6 +173,39 @@ def render_openmetrics(apps: dict) -> str:
     for rep, lab in per_graph():
         out.append(f"windflow_memory_bytes{_labels(**lab)} "
                    f"{int(rep.get('Memory_usage_KB', 0) or 0) * 1024}")
+    # audit plane: flow-conservation ledger state per graph
+    family("windflow_conservation_violations", "counter",
+           "flow-conservation ledger violations detected by the auditor")
+    for rep, lab in per_graph():
+        cons = rep.get("Conservation") or {}
+        out.append(f"windflow_conservation_violations_total"
+                   f"{_labels(**lab)} "
+                   f"{int(cons.get('Violations_total', 0) or 0)}")
+    family("windflow_conservation_balanced", "gauge",
+           "1 when every audited edge's delivery books balance")
+    for rep, lab in per_graph():
+        cons = rep.get("Conservation") or {}
+        if cons:
+            out.append(f"windflow_conservation_balanced{_labels(**lab)} "
+                       f"{1 if cons.get('Edges_balanced') else 0}")
+    family("windflow_keyed_state_keys", "gauge",
+           "keys held by a replica's keyed state (audit census)")
+    for rep, lab in per_graph():
+        skew = rep.get("Skew") or {}
+        for row in skew.get("Census", []):
+            out.append(
+                f"windflow_keyed_state_keys"
+                f"{_labels(**lab, replica=row.get('replica', ''))} "
+                f"{int(row.get('keys', 0) or 0)}")
+    family("windflow_hot_key_share", "gauge",
+           "estimated share of the hottest key on a KEYBY edge")
+    for rep, lab in per_graph():
+        skew = rep.get("Skew") or {}
+        for row in skew.get("Hot_keys", []):
+            out.append(
+                f"windflow_hot_key_share"
+                f"{_labels(**lab, operator=row.get('operator', ''))} "
+                f"{float(row.get('share', 0) or 0)}")
     family("windflow_e2e_latency_seconds", "histogram",
            "traced source-to-sink latency")
     for rep, lab in per_graph():

@@ -4,10 +4,14 @@ events (docs/OBSERVABILITY.md).
 Counters tell an operator *how much*; the flight recorder tells them
 *what happened just before it went wrong*: rescales, placement
 decisions, adaptive-batch resizes, credit stalls, admission sheds, svc
-failures, checkpoint epochs, watchdog stalls.  Events append into a
+failures, checkpoint epochs, watchdog stalls -- and, since the audit
+plane (audit/), ``conservation_violation`` (the flow ledger caught a
+lost/duplicated delivery) and ``frontier_stall`` (an operator's
+progress frontier froze while work was pending).  Events append into a
 ``deque(maxlen=N)`` (GIL-atomic, no lock on the hot path) and the ring
-is dumped as JSONL by the stall watchdog and the ``NodeFailureError``
-path in ``PipeGraph.wait_end``, so a post-mortem always has the last N
+is dumped as JSONL by the stall watchdog, the ``NodeFailureError``
+path in ``PipeGraph.wait_end``, and the auditor's final closure check
+when it finds violations, so a post-mortem always has the last N
 events of history even though the process is about to unwind.
 """
 from __future__ import annotations
