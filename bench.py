@@ -858,6 +858,73 @@ def run_audit_overhead(n_events):
     return rate_on, rate_off, overhead, w_on, cons
 
 
+def run_diagnosis_overhead(n_events):
+    """Config #10: the diagnosis-plane overhead gate
+    (docs/OBSERVABILITY.md "Diagnosis plane").  The identical 2f-style
+    materialized feed (template source -> WinSeqTPU sum -> sink) runs
+    with tracing ON in BOTH lanes (the diagnosis plane rides the
+    monitor/auditor ticks, so it only exists under an observed run) and
+    toggles ``RuntimeConfig.diagnosis``: ON adds the per-tick
+    critical-path attribution fold, the gauge-history ring, the
+    EWMA+MAD regression bands and the bottleneck walk; OFF restores the
+    PR 7/9 report shape.  Interleaved best-of-3, identical results
+    asserted (the plane is purely observational -- it never touches the
+    item path).  The ON lane additionally asserts ``explain()``
+    produces a report whose hop-class shares sum to ~100% of the traced
+    e2e latency.  Returns (rate_on, rate_off, overhead_frac, windows,
+    report_summary)."""
+    import warnings
+    import windflow_tpu as wf
+    from windflow_tpu.operators.batch_ops import BatchSource
+    from windflow_tpu.operators.basic_ops import Sink
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
+
+    n_events = max(int(n_events), 8_000_000)
+
+    def one(diagnosis):
+        src = _template_source(n_events, {}, SOURCE_BATCH)
+        cfg = wf.RuntimeConfig(tracing=True, diagnosis=diagnosis,
+                               diagnosis_interval_s=0.25)
+        g = wf.PipeGraph("bench10", wf.Mode.DEFAULT, config=cfg)
+        op = WinSeqTPU("sum", WIN, SLIDE, wf.WinType.TB,
+                       batch_len=DEVICE_BATCH, emit_batches=True,
+                       max_buffer_elems=MAX_BUFFER,
+                       inflight_depth=INFLIGHT)
+        sink = _CountSink()
+        g.add_source(BatchSource(src, SOURCE_PARALLELISM)).add(op) \
+            .add_sink(Sink(sink))
+        t0 = time.perf_counter()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # dashboard-less fallback
+            g.run()
+        dt = time.perf_counter() - t0
+        report = None
+        if diagnosis:
+            report = g.explain()
+            attr = report.get("Attribution")
+            if attr is not None:  # sampled: a short run may close none
+                assert abs(attr["Share_sum"] - 1.0) < 0.02, attr
+        return n_events / dt, sink.windows, sink.total, report
+
+    offs, ons = [], []
+    for _ in range(3):
+        offs.append(one(False))
+        ons.append(one(True))
+    rate_off, w_off, tot_off, _r = max(offs, key=lambda r: r[0])
+    rate_on, w_on, tot_on, report = max(ons, key=lambda r: r[0])
+    assert w_on == w_off and tot_on == tot_off, \
+        "diagnosis plane changed results"
+    overhead = 1.0 - rate_on / rate_off if rate_off else 0.0
+    bn = (report or {}).get("Bottleneck") or {}
+    attr = (report or {}).get("Attribution") or {}
+    summary = {"bottleneck": bn.get("Operator"),
+               "verdict": bn.get("Verdict"),
+               "traces": attr.get("Traces", 0),
+               "share_sum": attr.get("Share_sum"),
+               "anomalies_total": (report or {}).get("Anomalies_total", 0)}
+    return rate_on, rate_off, overhead, w_on, summary
+
+
 def run_reference_arch_baseline(n_events):
     """The honest baseline: identical workload through the native C++
     record-at-a-time engine in the reference's architecture (one thread
@@ -1135,6 +1202,17 @@ def main():
         "edges_balanced": (cons9 or {}).get("Edges_balanced"),
         "edges": (cons9 or {}).get("Edges_total"),
         "audit_passes": (cons9 or {}).get("Audit_passes")}
+    # diagnosis-plane overhead (docs/OBSERVABILITY.md "Diagnosis
+    # plane"): identical traced feed with the attribution / history /
+    # anomaly / bottleneck tick ON (the default) vs OFF, results
+    # asserted identical and hop-class shares summing to ~100%
+    r10_on, r10_off, ovh10, w10, diag10 = run_diagnosis_overhead(
+        N_EVENTS // 4)
+    configs["10_diagnosis_overhead"] = {
+        "rate": round(r10_on, 1), "rate_undiagnosed": round(r10_off, 1),
+        "windows": w10,
+        "overhead_frac": round(ovh10, 4),
+        **diag10}
     for name, c in configs.items():
         n_out = c.get("windows", c.get("records", 0))
         print(f"[bench] {name}: {c['rate']:,.0f} tuples/s "

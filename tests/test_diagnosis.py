@@ -1,0 +1,487 @@
+"""Diagnosis plane (windflow_tpu/diagnosis/; docs/OBSERVABILITY.md
+"Diagnosis plane"): critical-path latency attribution, the
+backpressure root-cause walk, the rolling gauge history ring, the
+EWMA+MAD regression monitor, ``PipeGraph.explain()``, the dashboard
+``/flight`` / ``/explain`` endpoints and the doctor CLI.
+
+Chaos coverage (the acceptance contract): a deliberately slow operator
+is named the dominant bottleneck (live, post-run, and from an offline
+dump through the CLI) with hop-class shares summing to ~100% of the
+traced e2e latency; a FaultPlan crash, an injected drop_put and a
+frontier stall each surface correctly in ``explain()``.  The suite
+runs on both channel planes (the WINDFLOW_NATIVE=0 CI job).
+"""
+import json
+import threading
+import time
+import urllib.request
+import warnings
+
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core import Mode, RuntimeConfig
+from windflow_tpu.diagnosis import (AttributionAccumulator,
+                                    RegressionMonitor, build_report,
+                                    render_text, trace_breakdown)
+from windflow_tpu.graph.pipegraph import NodeFailureError
+from windflow_tpu.resilience import FaultPlan
+
+WAIT_S = 60
+
+
+def quiet_run(g):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        g.run()
+
+
+def record_source(n, state=None):
+    state = state if state is not None else {}
+
+    def fn(shipper, ctx):
+        i = state.setdefault("i", 0)
+        if i >= n:
+            return False
+        shipper.push(wf.BasicRecord(i % 4, i // 4, i, float(i)))
+        state["i"] = i + 1
+        return True
+
+    return fn
+
+
+def diag_cfg(tmp_path, **kw):
+    kw.setdefault("tracing", True)
+    kw.setdefault("trace_sample", 4)
+    kw.setdefault("log_dir", str(tmp_path))
+    kw.setdefault("queue_capacity", 64)
+    kw.setdefault("audit_interval_s", 0.05)
+    kw.setdefault("diagnosis_interval_s", 0.05)
+    return RuntimeConfig(**kw)
+
+
+def slow_map_graph(tmp_path, n=4000, par=2, sleep_s=0.0008, **kw):
+    """Source -> deliberately slow map -> sink; par=2 forces real
+    channels (fusion needs a single producer), par=1 fuses the whole
+    chain into one replica."""
+    g = wf.PipeGraph(f"diag_slow{par}", Mode.DEFAULT,
+                     diag_cfg(tmp_path, **kw))
+
+    def slow(t):
+        time.sleep(sleep_s)
+        return None
+
+    g.add_source(wf.SourceBuilder(record_source(n)).build()) \
+        .add(wf.MapBuilder(slow).with_name("slowmap")
+             .with_parallelism(par).build()) \
+        .add_sink(wf.SinkBuilder(lambda r: None).build())
+    return g
+
+
+# ---------------------------------------------------------------------------
+# attribution units
+# ---------------------------------------------------------------------------
+
+def test_trace_breakdown_shares_cover_the_whole_span():
+    # hops: op A serves [1,3], fused-style op B nested [1.5, 2.5],
+    # gap [0,1] queues before A, gap [3,4] trails to the close
+    rec = {"e2e_ms": 4.0, "hops": [["pipe0/b.0", 1.5, 2.5],
+                                   ["pipe0/a", 1.0, 3.0]]}
+    bd = trace_breakdown(rec)
+    total = sum(bd["classes"].values())
+    assert total == pytest.approx(4.0)
+    # innermost attribution: b owns its nested [1.5, 2.5] interval
+    assert bd["operators"]["pipe0/b"]["service"] == pytest.approx(1.0)
+    assert bd["operators"]["pipe0/a"]["service"] == pytest.approx(1.0)
+    # the leading gap queues before a (replica suffix stripped)
+    assert bd["operators"]["pipe0/a"]["queueing"] == pytest.approx(1.0)
+    assert bd["classes"]["queueing"] == pytest.approx(2.0)
+
+
+def test_trace_breakdown_device_split_uses_rtt_floor():
+    rec = {"e2e_ms": 10.0, "hops": [["pipe0/win@device", 2.0, 8.0]]}
+    bd = trace_breakdown(rec, rtt_floor_ms=1.5)
+    dev = bd["operators"]["pipe0/win"]
+    assert dev["device_transport"] == pytest.approx(1.5)
+    assert dev["device_compute"] == pytest.approx(4.5)
+    assert sum(bd["classes"].values()) == pytest.approx(10.0)
+    # no rtt -> the whole hop reads as compute (documented fallback)
+    bd0 = trace_breakdown(rec, rtt_floor_ms=None)
+    assert bd0["classes"]["device_transport"] == 0.0
+    assert bd0["classes"]["device_compute"] == pytest.approx(6.0)
+
+
+def test_trace_breakdown_clamps_unwound_fused_stamps():
+    # fused upstream segments stamp AFTER the sink closes: done > e2e
+    rec = {"e2e_ms": 2.0, "hops": [["pipe0/src", 0.0, 2.4],
+                                   ["pipe0/sink", 0.5, 1.9]]}
+    bd = trace_breakdown(rec)
+    assert sum(bd["classes"].values()) == pytest.approx(2.0)
+
+
+def test_attribution_accumulator_tail_cohort_and_table():
+    acc = AttributionAccumulator()
+    for i in range(20):
+        e2e = 100.0 if i == 19 else 1.0  # one fat-tail trace
+        acc.add(trace_breakdown(
+            {"e2e_ms": e2e, "hops": [["pipe0/op", 0.0, e2e]]}))
+    blk = acc.block()
+    assert blk["Traces"] == 20
+    assert blk["Share_sum"] == pytest.approx(1.0)
+    assert blk["E2e_p99_ms"] == pytest.approx(100.0)
+    assert blk["Operators"][0]["operator"] == "pipe0/op"
+    assert blk["Operators"][0]["share"] == pytest.approx(1.0)
+    assert blk["Classes_tail"]["service"] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# regression monitor units
+# ---------------------------------------------------------------------------
+
+def test_regression_monitor_step_up_and_clear():
+    mon = RegressionMonitor(k=4.0, warmup=10, alpha=0.2)
+    events = []
+    t = 0.0
+    for v in [100.0] * 20:          # steady baseline
+        ev = mon.update("p99", v, "high", t)
+        assert ev is None
+        t += 1.0
+    for v in [100.0 * 50] * 5:      # 50x step: must open an episode
+        ev = mon.update("p99", v, "high", t)
+        if ev:
+            events.append(ev)
+        t += 1.0
+    assert [e["event"] for e in events] == ["regression"]
+    assert mon.active() and mon.active()[0]["series"] == "p99"
+    assert mon.opened_total == 1
+    # recovery: enough in-band ticks close the episode
+    for _ in range(100):
+        ev = mon.update("p99", 100.0, "high", t)
+        if ev:
+            events.append(ev)
+            break
+        t += 1.0
+    assert events[-1]["event"] == "regression_cleared"
+    assert mon.active() == []
+
+
+def test_regression_monitor_direction_low():
+    mon = RegressionMonitor(k=4.0, warmup=10)
+    for i in range(20):
+        mon.update("tput", 1000.0, "low", float(i))
+    assert mon.update("tput", 1.0, "low", 21.0) is None  # debounce
+    ev = mon.update("tput", 1.0, "low", 22.0)
+    assert ev and ev["event"] == "regression"
+    # a spike ABOVE the band is not a throughput regression
+    mon2 = RegressionMonitor(k=4.0, warmup=10)
+    for i in range(20):
+        mon2.update("tput", 1000.0, "low", float(i))
+    for i in range(5):
+        assert mon2.update("tput", 1e6, "low", 30.0 + i) is None
+
+
+# ---------------------------------------------------------------------------
+# the acceptance criterion: slow operator named, shares sum to ~100%
+# ---------------------------------------------------------------------------
+
+def test_slow_operator_named_bottleneck_live_and_post(tmp_path):
+    g = slow_map_graph(tmp_path, n=4000, par=2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        g.start()
+        time.sleep(1.0)
+        live = g.explain()
+        g.wait_end()
+    post = g.explain()
+    for rep in (live, post):
+        assert rep["Bottleneck"]["Operator"] == "pipe0/slowmap", \
+            rep["Bottleneck"]
+        assert rep["Bottleneck"]["Verdict"] != "input_bound"
+        attr = rep["Attribution"]
+        assert attr["Traces"] > 0
+        assert attr["Share_sum"] == pytest.approx(1.0, abs=0.02)
+        assert "pipe0/slowmap" in rep["Verdict"]
+    # the slow operator also dominates the attributed time
+    top = post["Attribution"]["Operators"][0]
+    assert top["operator"] == "pipe0/slowmap" and top["share"] > 0.5
+    # the stats JSON carries the published blocks
+    data = json.loads(g.stats.to_json())
+    assert data["Schema_version"] >= 3
+    assert data["Diagnosis"]["Bottleneck"]["Operator"] == "pipe0/slowmap"
+    assert ["pipe0/slowmap", "pipe0/sink", "channel"] in \
+        data["Topology"]["Edges"]
+    assert data["History"]["Len"] > 0
+
+
+def test_fused_chain_is_service_bound(tmp_path):
+    """par=1 fuses source+map+sink into ONE replica: no channels, no
+    queue evidence -- the attribution names the slow segment."""
+    g = slow_map_graph(tmp_path, n=1500, par=1)
+    quiet_run(g)
+    assert g.fused_nodes
+    rep = g.explain()
+    bn = rep["Bottleneck"]
+    assert bn["Operator"] == "pipe0/slowmap"
+    assert bn["Verdict"] == "service_bound"
+    assert bn["Score"] > 0.5
+
+
+def test_doctor_cli_names_bottleneck_from_offline_dump(tmp_path, capsys):
+    """The dump dir written by the dashboard-less snapshot fallback is
+    enough for the CLI to render the same verdict offline."""
+    from windflow_tpu import doctor
+    g = slow_map_graph(tmp_path, n=4000, par=2)
+    quiet_run(g)
+    assert (list(tmp_path.glob("*_stats.json"))
+            or list(tmp_path.glob("*.json")))
+    rc = doctor.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "pipe0/slowmap" in out
+    assert "bottleneck" in out
+    assert "share sum" in out
+    # --json emits the structured report
+    rc = doctor.main([str(tmp_path), "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    rep = json.loads(out)
+    assert rep["Bottleneck"]["Operator"] == "pipe0/slowmap"
+    assert rep["Attribution"]["Share_sum"] == pytest.approx(1.0,
+                                                            abs=0.02)
+
+
+def test_doctor_cli_rejects_missing_dump(tmp_path, capsys):
+    from windflow_tpu import doctor
+    assert doctor.main([str(tmp_path / "empty")]) == 2
+    assert "doctor:" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# chaos: crash, drop_put, frontier stall
+# ---------------------------------------------------------------------------
+
+def test_explain_after_fault_plan_crash(tmp_path):
+    plan = FaultPlan(seed=5).crash_replica("map", at_tuple=20)
+    cfg = diag_cfg(tmp_path, tracing=False, fault_plan=plan,
+                   cancel_grace_s=1.0)
+    g = wf.PipeGraph("diag_crash", config=cfg)
+    g.add_source(wf.SourceBuilder(record_source(5000)).build()) \
+        .add(wf.MapBuilder(lambda t: None).with_name("map").build()) \
+        .add_sink(wf.SinkBuilder(lambda r: None).build())
+    with pytest.raises(NodeFailureError):
+        quiet_run(g)
+    rep = g.explain()
+    assert rep["Failures"], rep["Flight_tail"]
+    assert rep["Verdict"].startswith("FAILED")
+    assert "node_failure" in {e.get("kind") for e in rep["Flight_tail"]}
+    assert "FAILED" in render_text(rep)
+
+
+def test_explain_surfaces_conservation_violation(tmp_path):
+    plan = FaultPlan().drop_put("map", at_put=10)
+    cfg = diag_cfg(tmp_path, tracing=False, fault_plan=plan)
+    g = wf.PipeGraph("diag_viol", config=cfg)
+    g.add_source(wf.SourceBuilder(record_source(200)).build()) \
+        .add(wf.MapBuilder(lambda t: t).with_name("map").build()) \
+        .add(wf.MapBuilder(lambda t: t).with_name("fan")
+             .with_parallelism(2).build()) \
+        .add_sink(wf.SinkBuilder(lambda r: None).build())
+    quiet_run(g)
+    rep = g.explain()
+    assert rep["Conservation"]["Violations"] >= 1
+    assert not rep["Conservation"]["Balanced"]
+    assert "conservation violation" in rep["Verdict"]
+
+
+def test_frontier_stall_names_wedged_sink(tmp_path):
+    release = threading.Event()
+
+    def sticky(rec):
+        if rec is not None and not release.is_set():
+            release.wait(WAIT_S)
+
+    cfg = diag_cfg(tmp_path, frontier_stall_s=0.2)
+    g = wf.PipeGraph("diag_stall", config=cfg)
+    g.add_source(wf.SourceBuilder(record_source(5000)).build()) \
+        .add(wf.MapBuilder(lambda t: t).with_parallelism(2).build()) \
+        .add_sink(wf.SinkBuilder(sticky).build())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        g.start()
+        try:
+            deadline = time.monotonic() + WAIT_S
+            while not any(e["kind"] == "frontier_stall"
+                          for e in g.flight.snapshot()):
+                assert time.monotonic() < deadline, "no stall event"
+                time.sleep(0.02)
+            rep = g.explain()
+        finally:
+            release.set()
+        g.wait_end()
+    bn = rep["Bottleneck"]
+    assert bn["Operator"] == "pipe0/sink", bn
+    assert bn["Evidence"]["frontier_lag_ms"] > 0 \
+        or bn["Evidence"]["depth_frac"] > 0
+
+
+# ---------------------------------------------------------------------------
+# history ring + anomaly wiring
+# ---------------------------------------------------------------------------
+
+def test_history_ring_bounded_and_columnar(tmp_path):
+    from windflow_tpu.diagnosis.history import SERIES
+    g = slow_map_graph(tmp_path, n=3000, par=2, history_len=8)
+    quiet_run(g)
+    data = json.loads(g.stats.to_json())
+    hist = data["History"]
+    assert 0 < hist["Len"] <= 8
+    assert len(hist["T"]) == hist["Len"]
+    for name in SERIES:
+        assert len(hist["Series"][name]) == hist["Len"]
+    assert g.diagnosis.ticks >= hist["Len"]
+
+
+def test_regression_flight_event_from_live_graph(tmp_path):
+    """A warmed-up throughput series that collapses to zero while the
+    graph stalls must open a regression episode (flight event +
+    Anomalies block)."""
+    release = threading.Event()
+    seen = {"n": 0}
+
+    def sticky(rec):
+        if rec is None:
+            return
+        seen["n"] += 1
+        if seen["n"] > 3000 and not release.is_set():
+            release.wait(WAIT_S)
+
+    cfg = diag_cfg(tmp_path, diagnosis_interval_s=0.02,
+                   anomaly_warmup=5, queue_capacity=256)
+    g = wf.PipeGraph("diag_regress", config=cfg)
+    g.add_source(wf.SourceBuilder(record_source(400_000)).build()) \
+        .add(wf.MapBuilder(lambda t: t).with_parallelism(2).build()) \
+        .add_sink(wf.SinkBuilder(sticky).build())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        g.start()
+        try:
+            deadline = time.monotonic() + WAIT_S
+            while not any(e["kind"] == "regression"
+                          for e in g.flight.snapshot()):
+                assert time.monotonic() < deadline, "no regression event"
+                g.diagnosis.maybe_tick(force=True)
+                time.sleep(0.02)
+        finally:
+            release.set()
+        g.wait_end()
+    evs = [e for e in g.flight.snapshot() if e["kind"] == "regression"]
+    assert evs and evs[0]["series"] in ("throughput_rps",
+                                        "e2e_p99_us",
+                                        "frontier_lag_ms")
+
+
+# ---------------------------------------------------------------------------
+# schema tolerance + export surfaces
+# ---------------------------------------------------------------------------
+
+def test_build_report_tolerates_missing_blocks():
+    # an empty dump still renders
+    rep = build_report({})
+    assert rep["Verdict"] == "no diagnosis signals"
+    assert render_text(rep)
+    # an old-style dump (no Schema_version / Diagnosis / Topology /
+    # History) recomputes attribution from Trace_records
+    old = {
+        "PipeGraph_name": "legacy",
+        "Trace_records": [
+            {"e2e_ms": 10.0, "hops": [["pipe0/slow", 0.5, 9.5]]}],
+        "Operators": [
+            {"Operator_name": "pipe0/slow",
+             "Replicas": [{"Queue_depth": 0}]}],
+    }
+    rep = build_report(old)
+    assert rep["Schema_version"] is None
+    assert rep["Attribution"]["Traces"] == 1
+    assert rep["Bottleneck"]["Operator"] == "pipe0/slow"
+    assert rep["Bottleneck"]["Verdict"] == "service_bound"
+
+
+def test_dashboard_flight_and_explain_endpoints(tmp_path):
+    from windflow_tpu.monitoring.dashboard import (DashboardServer,
+                                                   serve_http)
+    dash = DashboardServer(port=0)
+    dash.start()
+    httpd = serve_http(dash, port=0)
+    http_port = httpd.server_address[1]
+    try:
+        g = slow_map_graph(tmp_path, n=30_000, par=2,
+                           dashboard_port=dash.port)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            g.start()
+            g._monitor.interval_s = 0.1
+            g.wait_end()
+        deadline = time.time() + 10
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}{path}",
+                    timeout=5) as r:
+                return r.read().decode()
+
+        while True:
+            ex = json.loads(get("/explain"))
+            if ex or time.time() > deadline:
+                break
+            time.sleep(0.05)
+        assert ex, "no app reported to the dashboard"
+        rep = next(iter(ex.values()))
+        assert rep["Graph"] == "diag_slow2"
+        assert rep["Bottleneck"]["Operator"] == "pipe0/slowmap"
+        fl = json.loads(get("/flight"))
+        assert isinstance(next(iter(fl.values())), list)
+        met = get("/metrics")
+        assert "windflow_regressions_active" in met
+        assert "windflow_bottleneck_score" in met
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        dash.stop()
+
+
+def test_openmetrics_diagnosis_families_unit():
+    from windflow_tpu.telemetry import render_openmetrics
+    apps = {1: {"active": True, "report": {
+        "PipeGraph_name": "g",
+        "Diagnosis": {
+            "Anomalies": [{"series": "e2e_p99_us"}],
+            "Anomalies_total": 3,
+            "Bottleneck": {"Operator": "pipe0/slow", "Score": 0.8,
+                           "Verdict": "backpressure"},
+        },
+        "Operators": []}}}
+    text = render_openmetrics(apps)
+    assert 'windflow_regressions_active{app="1",graph="g"} 1' in text
+    assert 'windflow_regressions_total{app="1",graph="g"} 3' in text
+    assert 'operator="pipe0/slow"' in text
+    assert text.endswith("# EOF\n")
+
+
+def test_elastic_decide_scales_up_on_bottleneck_signal():
+    from windflow_tpu.elastic.controller import ElasticityConfig, decide
+    from windflow_tpu.elastic.signals import LoadReport
+    from windflow_tpu.core.basic import ElasticSpec
+    spec = ElasticSpec(min_replicas=1, max_replicas=8, target_util=0.75)
+    base = dict(operator="op", replicas=2, util=0.3, depth=0,
+                depth_frac=0.0, credit_wait_frac=0.0, rate=100.0,
+                at=0.0)
+    cfg = ElasticityConfig()
+    # named bottleneck: pressure even though util reads low
+    d = decide(LoadReport(**base, bottleneck=0.9), spec, cfg)
+    assert d is not None
+    n, trigger = d
+    assert n > 2 and "bottleneck=0.90" in trigger
+    # same load without the attribution signal: scale DOWN or hold,
+    # never up (proves the new trigger is what fired above)
+    d0 = decide(LoadReport(**base), spec, cfg)
+    assert d0 is None or d0[0] < 2 or d0[0] == 1

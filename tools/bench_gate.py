@@ -13,6 +13,13 @@ The threshold is deliberately generous (a config fails only below
 cliffs (a serialized hot path, an accidental per-tuple lock), not
 percent-level drift.
 
+Latency is gated too, where a config measures it (the stamped window-
+latency sinks of configs 2/2j): p50/p99 fail only ABOVE
+``LAT_THRESHOLD x`` their baseline AND above an absolute floor
+(``LAT_FLOOR_MS``), so sub-floor jitter on a noisy shared runner can
+never flag, while a latency cliff (a lost flush path, a serialized
+dispatcher) does even when throughput survives.
+
 Usage:
     python tools/bench_gate.py            # compare, exit 1 on cliffs
     python tools/bench_gate.py --write    # regenerate the baseline
@@ -28,13 +35,27 @@ BASELINE = os.path.join(ROOT, "bench_runs", "gate_baseline.json")
 
 # a config must stay above baseline_rate / THRESHOLD to pass
 THRESHOLD = 3.0
+# a latency percentile must stay below baseline * LAT_THRESHOLD ...
+LAT_THRESHOLD = 3.0
+# ... and only counts as a regression above this absolute floor
+LAT_FLOOR_MS = 5.0
 
 # tiny sizes: the gate must finish in ~a minute on a CI runner
 N_SMALL = 2_000_000
 N_NEX = 1_000_000
 
 
-def measure() -> dict:
+def _pcts_ms(lats_s):
+    """(p50_ms, p99_ms) of a seconds list, or None when unmeasured."""
+    if not lats_s:
+        return None
+    xs = sorted(lats_s)
+    p50 = xs[min(len(xs) - 1, int(0.50 * len(xs)))] * 1e3
+    p99 = xs[min(len(xs) - 1, int(0.99 * len(xs)))] * 1e3
+    return {"p50_ms": round(p50, 2), "p99_ms": round(p99, 2)}
+
+
+def measure() -> tuple:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import bench
     from windflow_tpu.core.basic import OptLevel
@@ -44,10 +65,12 @@ def measure() -> dict:
     bench.BASELINE_EVENTS = N_SMALL
 
     out = {}
+    lats = {}
     # warmup compiles the bucketed shape set once
     bench.run_win_seq_tpu(N_SMALL // 2)
-    r, _w, _dt, _lat = bench.run_win_seq_tpu(N_SMALL)
+    r, _w, _dt, lat = bench.run_win_seq_tpu(N_SMALL)
     out["2_win_seq_tpu"] = round(r, 1)
+    lats["2_win_seq_tpu"] = _pcts_ms(lat)
     r, _w, _dt, _lat = bench.run_win_seq_tpu(
         N_SMALL, chunked=False, opt_level=OptLevel.LEVEL0)
     out["2f_win_seq_tpu_feed_unfused"] = round(r, 1)
@@ -58,10 +81,11 @@ def measure() -> dict:
     # placement, plus both pinned lanes -- a cliff in 'auto' alone
     # means the planner picked the losing lane
     for lane in ("auto", "device", "host"):
-        r, _w, _lat, _plc, _dev = bench.run_planner_feed(
+        r, _w, lat, _plc, _dev = bench.run_planner_feed(
             N_SMALL, feeders=2, placement=lane)
         key = "2j_planner_feed" + ("" if lane == "auto" else f"_{lane}")
         out[key] = round(r, 1)
+        lats[key] = _pcts_ms(lat)
     # telemetry-plane smoke (docs/OBSERVABILITY.md): the traced lane
     # (tracing + default 1/N sampling) must stay within the cliff
     # threshold -- a regression here means per-item trace stamping
@@ -79,6 +103,15 @@ def measure() -> dict:
     r9_on, r9_off, _ovh9, _w9, _cons9 = bench.run_audit_overhead(N_SMALL)
     out["9_audit_feed"] = round(r9_on, 1)
     out["9_unaudited_feed"] = round(r9_off, 1)
+    # diagnosis-plane smoke (docs/OBSERVABILITY.md "Diagnosis plane"):
+    # the diagnosed lane (attribution fold + history ring + anomaly
+    # bands + bottleneck walk on the monitor tick) must stay within
+    # the cliff threshold; run_diagnosis_overhead itself asserts
+    # identical results and hop-class shares summing to ~100%
+    r10_on, r10_off, _ovh10, _w10, _d10 = \
+        bench.run_diagnosis_overhead(N_SMALL)
+    out["10_diagnosis_feed"] = round(r10_on, 1)
+    out["10_undiagnosed_feed"] = round(r10_off, 1)
     for q in ("q5", "q7"):
         # per-query warmup: each query's engine ('count'/'max') XLA-
         # compiles on first launch; without this the compile lands in
@@ -98,7 +131,7 @@ def measure() -> dict:
     r2i, _lats, _evs, (sunk, sent) = bench.run_elastic_step(3_000)
     assert sunk == sent, f"elastic step lost tuples: {sunk}/{sent}"
     out["2i_elastic_step"] = round(r2i, 1)
-    return out
+    return out, {k: v for k, v in lats.items() if v}
 
 
 def main() -> int:
@@ -106,18 +139,24 @@ def main() -> int:
     ap.add_argument("--write", action="store_true",
                     help="regenerate the committed gate baseline")
     ap.add_argument("--threshold", type=float, default=THRESHOLD)
+    ap.add_argument("--lat-threshold", type=float, default=LAT_THRESHOLD)
     args = ap.parse_args()
 
-    rates = measure()
+    rates, lats = measure()
     if args.write:
         os.makedirs(os.path.dirname(BASELINE), exist_ok=True)
         with open(BASELINE, "w") as f:
             json.dump({"n_small": N_SMALL, "n_nexmark": N_NEX,
-                       "threshold": args.threshold, "rates": rates},
+                       "threshold": args.threshold,
+                       "lat_threshold": args.lat_threshold,
+                       "rates": rates, "latencies": lats},
                       f, indent=1, sort_keys=True)
         print(f"[gate] baseline written: {BASELINE}")
         for k, v in sorted(rates.items()):
             print(f"[gate]   {k}: {v:,.0f} tuples/s")
+        for k, v in sorted(lats.items()):
+            print(f"[gate]   {k}: p50 {v['p50_ms']} / "
+                  f"p99 {v['p99_ms']} ms")
         return 0
 
     try:
@@ -139,8 +178,31 @@ def main() -> int:
               f"tuples/s ({ratio:.2f}x) {status}")
         if status != "OK":
             failed.append(name)
+    # latency gate: a percentile regresses only ABOVE lat_threshold x
+    # its baseline AND above the absolute floor (an older baseline
+    # without latencies skips the check rather than failing it)
+    base_lats = base.get("latencies") or {}
+    for name, pcts in sorted(lats.items()):
+        ref = base_lats.get(name)
+        if not ref:
+            print(f"[gate] {name}: p50 {pcts['p50_ms']} / "
+                  f"p99 {pcts['p99_ms']} ms (no latency baseline)")
+            continue
+        bad = []
+        for q in ("p50_ms", "p99_ms"):
+            v, b = pcts.get(q), ref.get(q)
+            if v is None or not b:
+                continue
+            if v > b * args.lat_threshold and v > LAT_FLOOR_MS:
+                bad.append(q)
+        status = "REGRESSION" if bad else "OK"
+        print(f"[gate] {name}: p50 {pcts['p50_ms']}/{ref.get('p50_ms')} "
+              f"p99 {pcts['p99_ms']}/{ref.get('p99_ms')} ms "
+              f"(vs baseline) {status}")
+        if bad:
+            failed.append(f"{name}[{'+'.join(bad)}]")
     if failed:
-        print(f"[gate] FAILED (>{args.threshold}x below baseline): "
+        print(f"[gate] FAILED (beyond threshold vs baseline): "
               f"{', '.join(failed)}")
         return 1
     print("[gate] all configs within threshold")

@@ -57,6 +57,11 @@ def __getattr__(name):
         # audit plane (audit/; docs/OBSERVABILITY.md "Audit plane")
         "GraphAuditor": "windflow_tpu.audit",
         "SpaceSavingSketch": "windflow_tpu.audit",
+        # diagnosis plane (diagnosis/; docs/OBSERVABILITY.md
+        # "Diagnosis plane")
+        "DiagnosisPlane": "windflow_tpu.diagnosis",
+        "build_report": "windflow_tpu.diagnosis",
+        "render_text": "windflow_tpu.diagnosis",
         # elastic scaling plane (elastic/; docs/ELASTIC.md)
         "ElasticityConfig": "windflow_tpu.elastic",
         "ElasticController": "windflow_tpu.elastic",

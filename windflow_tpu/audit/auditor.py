@@ -129,6 +129,12 @@ class GraphAuditor(threading.Thread):
         self.passes += 1
         self._refresh_skew(nodes)
         self._publish(edges, nodes)
+        # diagnosis plane (diagnosis/): audit passes keep the history /
+        # anomaly / bottleneck surfaces live even for untraced graphs
+        # (no monitor thread); rate-limited to diagnosis_interval_s
+        diag = getattr(g, "diagnosis", None)
+        if diag is not None:
+            diag.maybe_tick()
 
     def _record_violations(self, fresh: List[dict]) -> None:
         g = self.graph

@@ -255,6 +255,24 @@ class RuntimeConfig:
     frontier_stall_s: float = 5.0
     # hot-key sketch capacity per KEYBY emitter (space-saving top-K)
     audit_topk: int = 16
+    # -- diagnosis plane (diagnosis/; docs/OBSERVABILITY.md) ------------
+    # critical-path latency attribution + backpressure root-cause walk
+    # + rolling gauge history + EWMA/MAD regression detection, ticking
+    # on the monitor/auditor cadences and published as the Diagnosis /
+    # History stats-JSON blocks (PipeGraph.explain(), the dashboard
+    # /explain endpoint and `python -m windflow_tpu.doctor` read them).
+    # Purely observational: off restores the pre-diagnosis report shape
+    # with bitwise-identical results either way.
+    diagnosis: bool = True
+    # minimum seconds between diagnosis ticks (stacked callers --
+    # monitor, auditor, explain() -- are rate-limited to this)
+    diagnosis_interval_s: float = 1.0
+    # rolling gauge-history ring length (snapshot rows kept per graph)
+    history_len: int = 120
+    # regression band half-width in (MAD-derived) sigmas, and the
+    # samples a fresh series feeds its baseline before the band arms
+    anomaly_band_k: float = 4.0
+    anomaly_warmup: int = 12
     # dashboard-less snapshot fallback (monitoring/monitor.py): keep at
     # most this many *_stats.json snapshot files in log_dir (rotation
     # deletes the oldest); <= 0 keeps every file (the pre-rotation

@@ -1,0 +1,195 @@
+"""The doctor report: one structured answer to "where does the time
+go and who is the bottleneck" (docs/OBSERVABILITY.md "Diagnosis
+plane").
+
+:func:`build_report` is a pure function of a stats-JSON dict (plus an
+optional flight-event list), so the same code produces the report
+
+* live, via ``PipeGraph.explain()``,
+* server-side, at the dashboard's ``GET /explain``,
+* offline, from a stats-JSON / flight-JSONL dump directory
+  (``python -m windflow_tpu.doctor``).
+
+It prefers the precomputed ``Diagnosis`` block a diagnosing runtime
+published, and degrades gracefully on older dumps: the bottleneck walk
+and the attribution fold are recomputed from ``Operators``/``Topology``
+and ``Trace_records`` when the block is missing, and every block is
+optional (``Schema_version`` tolerance is the loader contract).
+
+:func:`render_text` turns the report into the aligned plain-text the
+doctor CLI prints.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .attribution import CLASSES, attribution_from_stats
+from .bottleneck import bottleneck_from_stats
+
+# flight events echoed into the report
+FLIGHT_TAIL = 8
+
+
+def build_report(stats: dict, flight: Optional[list] = None) -> dict:
+    """Fold one stats-JSON dict (any schema version, blocks optional)
+    into the structured doctor report."""
+    stats = stats or {}
+    if flight is None:
+        flight = stats.get("Flight") or []
+    diag = stats.get("Diagnosis") or {}
+    bottleneck = diag.get("Bottleneck") or bottleneck_from_stats(stats)
+    attribution = diag.get("Attribution") or attribution_from_stats(stats)
+    anomalies = diag.get("Anomalies") or []
+    cons = stats.get("Conservation")
+    conservation = None
+    if cons:
+        conservation = {
+            "Balanced": bool(cons.get("Edges_balanced")),
+            "Violations": int(cons.get("Violations_total", 0) or 0),
+            "Final_check": bool(cons.get("Final_check")),
+        }
+    skew = stats.get("Skew") or {}
+    hot = [{"operator": h.get("operator"),
+            "share": h.get("share"), "key": (h.get("top") or [[None]])[0][0]}
+           for h in (skew.get("Hot_keys") or [])
+           if (h.get("share") or 0) > 0]
+    hist = stats.get("History") or {}
+    series = hist.get("Series") or {}
+    history = None
+    if hist.get("Len"):
+        def last(name):
+            vals = series.get(name) or []
+            return vals[-1] if vals else None
+        history = {"Ticks": hist.get("Len"),
+                   "Throughput_rps": last("throughput_rps"),
+                   "E2e_p99_us": last("e2e_p99_us"),
+                   "Frontier_lag_ms": last("frontier_lag_ms"),
+                   "Queue_depth": last("queue_depth")}
+    failures = [e for e in flight
+                if e.get("kind") in ("node_failure", "stall")]
+    report = {
+        "Graph": stats.get("PipeGraph_name", "?"),
+        "Schema_version": stats.get("Schema_version"),
+        "Verdict": "",
+        "Bottleneck": bottleneck,
+        "Attribution": attribution,
+        "Anomalies": anomalies,
+        "Anomalies_total": diag.get("Anomalies_total", len(anomalies)),
+        "Conservation": conservation,
+        "Hot_keys": hot,
+        "History": history,
+        "Failures": failures,
+        "Flight_tail": list(flight)[-FLIGHT_TAIL:],
+    }
+    report["Verdict"] = _verdict(report)
+    return report
+
+
+def _verdict(report: dict) -> str:
+    """One-line human summary, worst news first."""
+    parts: List[str] = []
+    if report["Failures"]:
+        kinds = sorted({e.get("kind") for e in report["Failures"]})
+        parts.append(f"FAILED ({', '.join(kinds)})")
+    cons = report["Conservation"]
+    if cons and cons["Violations"]:
+        parts.append(f"{cons['Violations']} conservation violation(s)")
+    bn = report["Bottleneck"] or {}
+    if bn.get("Operator"):
+        if bn.get("Verdict") == "input_bound":
+            parts.append(f"input-bound at {bn['Operator']}")
+        else:
+            parts.append(f"bottleneck: {bn['Operator']} "
+                         f"(score {bn.get('Score', 0):.2f}, "
+                         f"{bn.get('Verdict')})")
+    n_anom = len(report["Anomalies"])
+    if n_anom:
+        parts.append(f"{n_anom} active regression(s)")
+    if cons and not cons["Violations"] and cons["Balanced"]:
+        parts.append("ledger balanced")
+    return "; ".join(parts) if parts else "no diagnosis signals"
+
+
+def _pct(v) -> str:
+    return f"{(v or 0) * 100:5.1f}%"
+
+
+def render_text(report: dict) -> str:
+    """Aligned plain-text rendering (the doctor CLI output)."""
+    out: List[str] = []
+    out.append(f"== doctor: {report.get('Graph', '?')} "
+               f"(schema {report.get('Schema_version')}) ==")
+    out.append(f"verdict: {report.get('Verdict')}")
+    bn = report.get("Bottleneck") or {}
+    if bn.get("Operator"):
+        out.append("")
+        out.append(f"bottleneck: {bn['Operator']}  "
+                   f"score={bn.get('Score', 0):.2f}  "
+                   f"verdict={bn.get('Verdict')}")
+        ev = bn.get("Evidence") or {}
+        if ev:
+            out.append(f"  depth_frac={ev.get('depth_frac')}  "
+                       f"sustained={ev.get('sustained_depth')}  "
+                       f"hwm_frac={ev.get('hwm_frac')}  "
+                       f"frontier_lag_ms={ev.get('frontier_lag_ms')}  "
+                       f"svc_us={ev.get('service_time_us')}")
+        for row in bn.get("Sinks") or []:
+            if row is not bn:
+                out.append(f"  sink {row.get('sink')}: "
+                           f"{row.get('operator')} "
+                           f"({row.get('verdict')}, "
+                           f"score {row.get('score', 0):.2f})")
+    attr = report.get("Attribution")
+    if attr:
+        out.append("")
+        out.append(f"attribution ({attr.get('Traces')} traces, "
+                   f"e2e p50 {attr.get('E2e_p50_ms')} ms / "
+                   f"p99 {attr.get('E2e_p99_ms')} ms, "
+                   f"share sum {attr.get('Share_sum')}):")
+        cls = attr.get("Classes") or {}
+        tail = attr.get("Classes_tail") or {}
+        out.append("  class              all     tail(p90+)")
+        for c in CLASSES:
+            out.append(f"  {c:<17}{_pct(cls.get(c))}  {_pct(tail.get(c))}")
+        ops = attr.get("Operators") or []
+        if ops:
+            out.append("  operator breakdown (share of traced time):")
+            for row in ops[:8]:
+                rc = row.get("classes") or {}
+                detail = " ".join(f"{c.split('_')[-1]}={_pct(rc.get(c)).strip()}"
+                                  for c in CLASSES if (rc.get(c) or 0) >= 0.0005)
+                out.append(f"    {_pct(row.get('share'))}  "
+                           f"{row.get('operator')}  [{detail}]")
+    anoms = report.get("Anomalies") or []
+    if anoms:
+        out.append("")
+        out.append("active regressions:")
+        for a in anoms:
+            out.append(f"  {a.get('series')}: {a.get('value')} outside "
+                       f"{a.get('band')}")
+    cons = report.get("Conservation")
+    if cons:
+        out.append("")
+        out.append(f"conservation: balanced={cons['Balanced']} "
+                   f"violations={cons['Violations']} "
+                   f"final={cons['Final_check']}")
+    hot = report.get("Hot_keys") or []
+    if hot:
+        out.append("hot keys: " + ", ".join(
+            f"{h['operator']} key={h['key']} share={h['share']}"
+            for h in hot[:4]))
+    hist = report.get("History")
+    if hist:
+        out.append(f"history: {hist['Ticks']} ticks, last sink rate "
+                   f"{hist['Throughput_rps']} results/s, e2e p99 "
+                   f"{hist['E2e_p99_us']} us, frontier lag "
+                   f"{hist['Frontier_lag_ms']} ms")
+    tail = report.get("Flight_tail") or []
+    if tail:
+        out.append("")
+        out.append("flight tail:")
+        for e in tail:
+            fields = " ".join(f"{k}={v}" for k, v in e.items()
+                              if k not in ("t", "kind"))
+            out.append(f"  [{e.get('t')}] {e.get('kind')} {fields}")
+    return "\n".join(out)

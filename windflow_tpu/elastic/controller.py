@@ -42,6 +42,10 @@ class ElasticityConfig:
     # credit-starvation trigger: fraction of source wall time spent
     # blocked on credits that counts as upstream pressure
     credit_wait_high: float = 0.5
+    # attribution trigger: diagnosis-plane bottleneck score from which
+    # being named the root cause behind a sink counts as pressure
+    # (fires only for the culprit operator, not the cascade behind it)
+    bottleneck_high: float = 0.6
     # max replicas added/removed per decision (0 = jump straight to the
     # proportional estimate)
     max_step: int = 0
@@ -56,7 +60,8 @@ def decide(report: LoadReport, spec, cfg: ElasticityConfig) \
     hi = spec.target_util + cfg.hysteresis
     lo = spec.target_util - cfg.hysteresis
     pressured = (report.depth_frac >= cfg.depth_high_frac
-                 or report.credit_wait_frac >= cfg.credit_wait_high)
+                 or report.credit_wait_frac >= cfg.credit_wait_high
+                 or report.bottleneck >= cfg.bottleneck_high)
     desired = n
     if report.util > hi or pressured:
         base = max(report.util, spec.target_util)  # backlog with a noisy
@@ -83,6 +88,10 @@ def decide(report: LoadReport, spec, cfg: ElasticityConfig) \
         # operator diagnosing a scale-up that did not help can see the
         # hot key was the bottleneck, not replica count
         trigger += f" skew={report.skew:.2f}"
+    if report.bottleneck > 0.0:
+        # diagnosis-plane attribution: the root-cause walk named this
+        # operator the bottleneck behind a sink with this score
+        trigger += f" bottleneck={report.bottleneck:.2f}"
     return desired, trigger
 
 

@@ -48,6 +48,13 @@ class LoadReport:
     # scaling out cannot relieve it -- so the controller records the
     # signal with every decision it makes on this operator
     skew: float = 0.0
+    # diagnosis plane (diagnosis/bottleneck.py): the root-cause walk's
+    # pressure score when IT named this operator the bottleneck behind
+    # a sink (0.0 otherwise).  Attribution-aware scaling: unlike the
+    # raw depth_frac above, this only fires for the operator where the
+    # backpressure actually originates, so a cascade of full queues
+    # scales the culprit instead of every operator on the path
+    bottleneck: float = 0.0
 
 
 class OperatorSignals:
@@ -126,9 +133,16 @@ class OperatorSignals:
         # hot-key skew from the audit plane's KEYBY sketches (0.0 when
         # the auditor is off or the operator is not KEYBY-fed)
         skew = 0.0
-        auditor = getattr(self.handle.pipe.graph, "auditor", None)
+        graph = self.handle.pipe.graph
+        auditor = getattr(graph, "auditor", None)
         if auditor is not None:
             skew = auditor.skew_of(self.handle.name)
+        # root-cause score from the diagnosis plane's bottleneck walk
+        # (0.0 when the plane is off or another operator is the cause)
+        bottleneck = 0.0
+        diag = getattr(graph, "diagnosis", None)
+        if diag is not None:
+            bottleneck = diag.bottleneck_score(self.handle.name)
         return LoadReport(
             operator=self.handle.name,
             replicas=len(nodes),
@@ -139,6 +153,7 @@ class OperatorSignals:
             rate=d_in / dt,
             at=now,
             skew=skew,
+            bottleneck=bottleneck,
         )
 
 

@@ -85,6 +85,10 @@ class PipeGraph:
         # flow-conservation ledger + frontier tracker + skew census
         # thread, built at start() when RuntimeConfig.audit is on
         self.auditor = None
+        # diagnosis plane (diagnosis/; docs/OBSERVABILITY.md): critical-
+        # path attribution, bottleneck walk, gauge history + regression
+        # bands, built at start() when RuntimeConfig.diagnosis is on
+        self.diagnosis = None
 
     # -- construction ------------------------------------------------------
     def _new_pipe(self) -> MultiPipe:
@@ -341,6 +345,14 @@ class PipeGraph:
             from ..audit import GraphAuditor
             self.auditor = GraphAuditor(self)
             self.auditor.attach()
+        # diagnosis plane (diagnosis/; docs/OBSERVABILITY.md): built
+        # after the wiring above so its one-time topology snapshot sees
+        # the post-fusion operator chains.  No thread of its own --
+        # ticks ride the monitor/auditor cadences and explain() calls
+        if self.config.diagnosis:
+            from ..diagnosis import DiagnosisPlane
+            self.diagnosis = DiagnosisPlane(self)
+            self.stats.set_topology(self.diagnosis.edges)
         for n in self._all_nodes():
             n.start()
         if self.auditor is not None:
@@ -479,12 +491,18 @@ class PipeGraph:
         import os
         from ..monitoring.monitor import graph_to_dot, graph_to_svg
         self.refresh_gauges()
+        if self.diagnosis is not None:
+            # final tick: the dumped Diagnosis/History blocks carry the
+            # end-of-run state (sustained-pressure EWMAs survive the
+            # drain, so an offline doctor still names the bottleneck)
+            self.diagnosis.maybe_tick(force=True)
         d = self.config.log_dir
         os.makedirs(d, exist_ok=True)
         pid = os.getpid()
         with open(os.path.join(d, f"{pid}_{self.name}.json"), "w") as f:
             f.write(self.stats.to_json(self.get_num_dropped_tuples(),
-                                       self.dead_letters.count()))
+                                       self.dead_letters.count(),
+                                       flight_events=self.flight.snapshot()))
         with open(os.path.join(d, f"{pid}_{self.name}.dot"), "w") as f:
             f.write(graph_to_dot(self))
         with open(os.path.join(d, f"{pid}_{self.name}.svg"), "w") as f:
@@ -646,6 +664,27 @@ class PipeGraph:
                                        delta_s=round(wait - last, 3))
                 rec._flight_wait_s = wait
                 rec.credit_wait_s = wait
+
+    # -- diagnosis plane (diagnosis/; docs/OBSERVABILITY.md) ------------
+    def explain(self) -> dict:
+        """The structured doctor report for this graph: dominant
+        bottleneck per sink, critical-path hop-class breakdown of the
+        traced e2e latency, active regression episodes, conservation /
+        skew status and the flight-recorder tail.  Works on a running
+        graph (live gauges) and after ``wait_end`` (the sustained
+        EWMAs and high-watermarks keep the verdict through the drain);
+        the same pure fold backs the dashboard's ``GET /explain`` and
+        ``python -m windflow_tpu.doctor``."""
+        if not self._started:
+            raise RuntimeError("explain() needs a started graph")
+        import json as _json
+        from ..diagnosis.report import build_report
+        self.refresh_gauges()
+        if self.diagnosis is not None:
+            self.diagnosis.maybe_tick(force=True)
+        stats = _json.loads(self.stats.to_json(
+            self.get_num_dropped_tuples(), self.dead_letters.count()))
+        return build_report(stats, self.flight.snapshot())
 
     def live_checkpoint(self, path: str, timeout: float = 120.0) -> int:
         """Mid-stream snapshot: quiesce, save every replica's state

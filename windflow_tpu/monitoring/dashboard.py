@@ -109,21 +109,42 @@ def serve_http(dash: DashboardServer, port: int = 20208):
     front-end at ``/`` (webui.py -- the React-dashboard equivalent),
     the OpenMetrics text exposition at ``/metrics`` (telemetry/
     metrics.py -- point a Prometheus scraper here and every traced
-    graph's counters and latency histograms come along) and the JSON
-    state at ``/apps`` (and any other path, kept permissive for curl
-    users)."""
+    graph's counters and latency histograms come along), the
+    diagnosis surfaces at ``/flight`` (per-app FlightRecorder ring, as
+    shipped inside the monitor reports -- reachable without a stall or
+    crash triggering a JSONL dump) and ``/explain`` (per-app doctor
+    report, the same pure fold as ``PipeGraph.explain()`` and the
+    doctor CLI), and the JSON state at ``/apps`` (and any other path,
+    kept permissive for curl users)."""
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
+            path = self.path.split("?", 1)[0]
             if self.path in ("/", "/index.html"):
                 from .webui import HTML_PAGE
                 body = HTML_PAGE.encode()
                 ctype = "text/html; charset=utf-8"
-            elif self.path.split("?", 1)[0] == "/metrics":
+            elif path == "/metrics":
                 from ..telemetry.metrics import (CONTENT_TYPE,
                                                  render_openmetrics)
                 body = render_openmetrics(dash.snapshot()).encode()
                 ctype = CONTENT_TYPE
+            elif path == "/flight":
+                snap = dash.snapshot()
+                body = json.dumps({
+                    str(aid): (app.get("report") or {}).get("Flight") or []
+                    for aid, app in snap.items()
+                    if isinstance(app, dict)}).encode()
+                ctype = "application/json"
+            elif path == "/explain":
+                from ..diagnosis.report import build_report
+                snap = dash.snapshot()
+                out = {}
+                for aid, app in snap.items():
+                    if isinstance(app, dict) and app.get("report"):
+                        out[str(aid)] = build_report(app["report"])
+                body = json.dumps(out).encode()
+                ctype = "application/json"
             else:
                 body = json.dumps(dash.snapshot()).encode()
                 ctype = "application/json"

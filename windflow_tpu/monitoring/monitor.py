@@ -24,6 +24,11 @@ import struct
 import threading
 import warnings
 
+# flight-recorder events shipped inside each monitor report (the
+# dashboard's /flight endpoint and the doctor's offline path read
+# them; the full ring still dumps as JSONL on failure)
+FLIGHT_IN_REPORT = 256
+
 
 def _dot_quote(s: str) -> str:
     """DOT double-quoted-string escaping: a backslash or quote in an
@@ -175,10 +180,20 @@ class MonitoringThread(threading.Thread):
         refresh = getattr(self.graph, "refresh_gauges", None)
         if refresh is not None:
             refresh()  # channel-depth / credit-wait gauges per replica
+        # diagnosis plane (diagnosis/): the monitor tick doubles as the
+        # history/anomaly/attribution cadence (rate-limited internally)
+        diag = getattr(self.graph, "diagnosis", None)
+        if diag is not None:
+            diag.maybe_tick()
         if stats is not None:
             dls = getattr(self.graph, "dead_letters", None)
+            flight = getattr(self.graph, "flight", None)
+            events = None
+            if flight is not None and flight.enabled:
+                events = flight.snapshot()[-FLIGHT_IN_REPORT:]
             return stats.to_json(self.graph.get_num_dropped_tuples(),
-                                 dls.count() if dls is not None else 0)
+                                 dls.count() if dls is not None else 0,
+                                 flight_events=events)
         return "{}"
 
     # -- thread body -------------------------------------------------------

@@ -20,6 +20,14 @@ from typing import Dict, List, Optional
 
 from ..telemetry.histogram import LogHistogram
 
+# Stats-JSON schema version (the top-level ``Schema_version`` field).
+# 3 = the diagnosis-plane layout (adds Topology / Diagnosis / History /
+# optional Flight on top of the PR 7 telemetry and PR 9 audit blocks).
+# Readers (doctor CLI, dashboard /explain, tests) must tolerate MISSING
+# blocks rather than dispatch on this number: older dumps carry no
+# version field at all, and every block is optional by contract.
+SCHEMA_VERSION = 3
+
 
 @dataclass
 class StatsRecord:
@@ -217,6 +225,12 @@ class GraphStats:
         # after every pass (and after the wait_end final check)
         self.audit_conservation: Optional[dict] = None
         self.audit_skew: Optional[dict] = None
+        # diagnosis plane (diagnosis/; docs/OBSERVABILITY.md): the
+        # operator-level topology (set once at start), and the latest
+        # Diagnosis / History blocks published per tick
+        self.topology: Optional[List[List[str]]] = None
+        self.diagnosis: Optional[dict] = None
+        self.history: Optional[dict] = None
 
     def register(self, operator_name: str, replica_id: str) -> StatsRecord:
         rec = StatsRecord(operator_name, replica_id)
@@ -267,8 +281,22 @@ class GraphStats:
             self.audit_conservation = conservation
             self.audit_skew = skew
 
+    def set_topology(self, edges: List[List[str]]) -> None:
+        """Record the operator-level edge list (diagnosis/topology.py)
+        so the bottleneck walk works on serialized reports too."""
+        with self.lock:
+            self.topology = list(edges)
+
+    def set_diagnosis(self, block: dict, history: Optional[dict]) -> None:
+        """Publish the diagnosis plane's latest Diagnosis/History
+        blocks (diagnosis/plane.py, once per tick)."""
+        with self.lock:
+            self.diagnosis = block
+            self.history = history
+
     def to_json(self, dropped_tuples: int = 0,
-                dead_letter_tuples: int = 0) -> str:
+                dead_letter_tuples: int = 0,
+                flight_events: Optional[List[dict]] = None) -> str:
         with self.lock:
             ops = []
             for name, replicas in self.records.items():
@@ -299,6 +327,9 @@ class GraphStats:
             placements = list(self.placements)
             conservation = self.audit_conservation
             skew = self.audit_skew
+            topology = self.topology
+            diagnosis = self.diagnosis
+            history = self.history
             latency_e2e = None
             trace_records: List[dict] = []
             if self.histograms:
@@ -313,8 +344,11 @@ class GraphStats:
                 # when a sink thread closes a trace mid-report
                 trace_records = [ctx.to_dict(t_end)
                                  for ctx, t_end in list(self.trace_records)]
-        return json.dumps({
+        payload = {
             "PipeGraph_name": self.graph_name,
+            # report-shape version (see SCHEMA_VERSION above); loaders
+            # must treat every block below as optional regardless
+            "Schema_version": SCHEMA_VERSION,
             "Mode": "DEFAULT",
             "Backpressure": "ON",
             "Dropped_tuples": dropped_tuples,
@@ -348,7 +382,22 @@ class GraphStats:
             # sampling is off
             "Latency_e2e": latency_e2e,
             "Trace_records": trace_records,
+            # diagnosis plane (diagnosis/; docs/OBSERVABILITY.md):
+            # operator-level topology edges, the latest critical-path /
+            # bottleneck / anomaly diagnosis, and the rolling gauge
+            # history ring; None until the first tick (or with the
+            # plane disabled)
+            "Topology": {"Edges": topology} if topology else None,
+            "Diagnosis": diagnosis,
+            "History": history,
             "Memory_usage_KB": get_mem_usage_kb(),
             "Operator_number": len(ops),
             "Operators": ops,
-        })
+        }
+        if flight_events is not None:
+            # bounded FlightRecorder ring snapshot: ships with the
+            # monitor reports so the dashboard's /flight endpoint (and
+            # the doctor's offline path) can read recent events without
+            # a stall/crash triggering a JSONL dump
+            payload["Flight"] = flight_events
+        return json.dumps(payload)

@@ -76,6 +76,8 @@ HTML_PAGE = """<!DOCTYPE html>
   td { text-align: right; padding: 4px 10px; border-bottom: 1px solid
        var(--grid); }
   .spark-wrap { position: relative; margin-top: 6px; }
+  .hist-row { display: flex; flex-wrap: wrap; gap: 14px; margin-top: 6px; }
+  .hist-row .k { color: var(--text-secondary); font-size: 11px; }
   #tip { position: fixed; pointer-events: none; display: none;
          background: var(--surface-2); border: 1px solid var(--grid);
          border-radius: 4px; padding: 2px 8px; font-size: 11px;
@@ -212,6 +214,39 @@ const lus = v => { const n = num(v);
   return n >= 1e6 ? (n / 1e6).toFixed(2) + "s"
        : n >= 1e3 ? (n / 1e3).toFixed(1) + "ms" : n.toFixed(0) + "us"; };
 
+// diagnosis plane: server-side gauge-history sparklines (the History
+// stats block -- trends survive a page reload, unlike the client-side
+// report-delta history above)
+function histSpark(label, vals, fmtfn) {
+  if (!vals || vals.length < 2) return "";
+  const W = 150, H = 36;
+  const mx = Math.max(...vals), mn = Math.min(...vals, 0);
+  const pts = vals.map((v, i) =>
+    [4 + i * (W - 8) / (vals.length - 1),
+     H - 8 - (num(v) - mn) / ((mx - mn) || 1) * (H - 18)]);
+  return `<div><svg width="${W}" height="${H}" role="img"
+      aria-label="${esc(label)}">
+    <line x1="4" y1="${H - 8}" x2="${W - 4}" y2="${H - 8}"
+      stroke="var(--grid)" />
+    <polyline fill="none" stroke="var(--series-1)" stroke-width="1.5"
+      points="${pts.map(p => p[0].toFixed(1) + "," + p[1].toFixed(1)).join(" ")}" />
+    <text x="${W - 4}" y="10" text-anchor="end">
+      ${fmtfn(vals[vals.length - 1])}</text>
+  </svg><div class="k">${esc(label)}</div></div>`;
+}
+
+function historyRow(hist) {
+  const s = (hist || {}).Series || {};
+  if (!(hist || {}).Len) return "";
+  return `<div class="hist-row">
+    ${histSpark("results/s (history)", s.throughput_rps, fmt)}
+    ${histSpark("e2e p99", s.e2e_p99_us, lus)}
+    ${histSpark("frontier lag", s.frontier_lag_ms,
+                v => num(v).toFixed(0) + "ms")}
+    ${histSpark("queue depth", s.queue_depth, fmt)}
+  </div>`;
+}
+
 // audit plane: keyed-state census + hot-key skew (Skew block)
 function skewTable(skew) {
   if (!skew) return "";
@@ -345,9 +380,22 @@ function render(apps) {
             ${lus(rep.Latency_e2e.p99_us)}</div>
           <div class="k">e2e latency p50/p99
             (${fmt(rep.Latency_e2e.n)} traces)</div></div>` : ""}
+        ${(() => {  // diagnosis plane: doctor verdict tile
+          const d = rep.Diagnosis || {}, bn = d.Bottleneck || {};
+          const anoms = (d.Anomalies || []).length;
+          if (!bn.Operator && !anoms) return "";
+          const bad = anoms || bn.Verdict === "backpressure";
+          const name = String(bn.Operator || "\\u2013");
+          return `<div class="tile"><div class="v${bad ? " bad" : ""}">
+            ${esc(name.length > 16 ? "\\u2026" + name.slice(-15) : name)}
+            </div><div class="k">bottleneck (${esc(bn.Verdict || "?")},
+            score ${num(bn.Score).toFixed(2)},
+            ${anoms} regression${anoms === 1 ? "" : "s"})</div></div>`;
+        })()}
       </div>
       ${a.diagram.trim().startsWith("<svg") ? svgImg(a.diagram) : topoSvg(parseDot(a.diagram))}
       <div class="spark-wrap">${sparkline(id, hist[id])}</div>
+      ${historyRow(rep.History)}
       <table><thead><tr><th>operator</th><th>par</th><th>in</th>
         <th>out</th><th>ignored</th><th>fails</th><th>shed</th>
         <th>q-depth</th><th>q-hwm</th><th>fr-lag</th><th>cr-wait</th>

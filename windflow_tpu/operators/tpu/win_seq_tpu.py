@@ -554,12 +554,19 @@ class WinSeqTPULogic(NodeLogic):
                                    new_len=self.batch_len,
                                    launch_ms=round(launch_ms, 3))
         # trace crossing (telemetry/): the sampled context captured at
-        # svc gets a device hop (submit -> result-on-host) and rides
-        # the result batch to the sink
+        # svc gets an engine hop (submit -> result-on-host) and rides
+        # the result batch to the sink.  On the device lane the
+        # "@device" suffix keys the diagnosis plane's hop-class split
+        # (device transport/compute vs host service --
+        # diagnosis/attribution.py); the host lane's launches are host
+        # service time and stamp plain
         tr = self._trace_ctx
         if tr is not None:
             self._trace_ctx = None
-            tr.hop(self._trace_name, t_sub, now)
+            name = self._trace_name
+            if self.resolved_placement != "host":
+                name += "@device"
+            tr.hop(name, t_sub, now)
         self._emit_results(results, descs, emit, trace=tr)
 
     def _submit(self, cols, starts, ends, gwids, descs, birth, emit,

@@ -206,6 +206,34 @@ def render_openmetrics(apps: dict) -> str:
                 f"windflow_hot_key_share"
                 f"{_labels(**lab, operator=row.get('operator', ''))} "
                 f"{float(row.get('share', 0) or 0)}")
+    # diagnosis plane (diagnosis/; docs/OBSERVABILITY.md): regression
+    # episodes currently outside their EWMA+MAD band, and the dominant
+    # bottleneck's pressure score (labelled with the operator the
+    # root-cause walk named)
+    family("windflow_regressions_active", "gauge",
+           "gauge series currently outside their EWMA+MAD band")
+    for rep, lab in per_graph():
+        diag = rep.get("Diagnosis") or {}
+        if diag:
+            out.append(f"windflow_regressions_active{_labels(**lab)} "
+                       f"{len(diag.get('Anomalies') or [])}")
+    family("windflow_regressions", "counter",
+           "regression episodes opened since graph start")
+    for rep, lab in per_graph():
+        diag = rep.get("Diagnosis") or {}
+        if diag:
+            out.append(f"windflow_regressions_total{_labels(**lab)} "
+                       f"{int(diag.get('Anomalies_total', 0) or 0)}")
+    family("windflow_bottleneck_score", "gauge",
+           "pressure score of the dominant bottleneck operator named "
+           "by the diagnosis root-cause walk")
+    for rep, lab in per_graph():
+        bn = (rep.get("Diagnosis") or {}).get("Bottleneck") or {}
+        if bn.get("Operator"):
+            out.append(
+                f"windflow_bottleneck_score"
+                f"{_labels(**lab, operator=bn['Operator'], verdict=bn.get('Verdict', ''))} "
+                f"{float(bn.get('Score', 0) or 0)}")
     family("windflow_e2e_latency_seconds", "histogram",
            "traced source-to-sink latency")
     for rep, lab in per_graph():
