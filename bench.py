@@ -925,6 +925,103 @@ def run_diagnosis_overhead(n_events):
     return rate_on, rate_off, overhead, w_on, summary
 
 
+def run_checkpoint_overhead(n_events, interval_s=1.0):
+    """Config #11: the durability-plane overhead gate
+    (docs/RESILIENCE.md "Exactly-once epochs").  The identical 2f-style
+    materialized feed (template source -> WinSeqTPU sum -> sink) runs
+    with the epoch coordinator ON (aligned barriers at ``interval_s``,
+    per-replica snapshots as they pass, atomic manifest commits -- no
+    graph-wide quiesce) and OFF (durability=None, the pre-epoch hot
+    path), interleaved best-of-3.  The durable lane must (a) produce
+    identical results, (b) commit at least one epoch, and (c) stay
+    within the acceptance band on throughput (< 5% overhead at 1 Hz in
+    the gated config).  Also measures RECOVERY TIME: loading the last
+    committed manifest into a freshly built graph.  Returns (rate_on,
+    rate_off, overhead_frac, windows, durability_summary)."""
+    import shutil
+    import tempfile
+    import windflow_tpu as wf
+    from windflow_tpu.core import DurabilityConfig
+    from windflow_tpu.durability import EpochStore, restore_epoch
+    from windflow_tpu.operators.batch_ops import BatchSource
+    from windflow_tpu.operators.basic_ops import Sink
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
+
+    n_events = max(int(n_events), 8_000_000)
+    tmp = tempfile.mkdtemp(prefix="windflow-epochs-")
+    interval_used = [interval_s]
+
+    def build(durable, epoch_dir):
+        src = _template_source(n_events, {}, SOURCE_BATCH)
+        cfg = wf.RuntimeConfig(
+            durability=(DurabilityConfig(
+                epoch_interval_s=interval_used[0], path=epoch_dir)
+                if durable else None))
+        g = wf.PipeGraph("bench11", wf.Mode.DEFAULT, config=cfg)
+        op = WinSeqTPU("sum", WIN, SLIDE, wf.WinType.TB,
+                       batch_len=DEVICE_BATCH, emit_batches=True,
+                       max_buffer_elems=MAX_BUFFER,
+                       inflight_depth=INFLIGHT)
+        sink = _CountSink()
+        g.add_source(BatchSource(src, SOURCE_PARALLELISM)).add(op) \
+            .add_sink(Sink(sink))
+        return g, sink
+
+    def one(durable, run_idx):
+        epoch_dir = os.path.join(tmp, f"run{run_idx}")
+        g, sink = build(durable, epoch_dir)
+        t0 = time.perf_counter()
+        g.run()
+        dt = time.perf_counter() - t0
+        commits = recovery_s = None
+        if durable:
+            # PERIODIC commits only: the clean-end final commit always
+            # happens, so counting it would make the >=1 assertion
+            # below vacuous (it must prove the epoch cadence engaged)
+            commits = sum(1 for e in g.flight.snapshot()
+                          if e["kind"] == "epoch_commit"
+                          and not e.get("final"))
+            # recovery time: newest manifest -> freshly built graph
+            store = EpochStore(epoch_dir)
+            epoch, payload = store.latest()
+            if epoch is not None:
+                g2, _s2 = build(True, epoch_dir)
+                t0 = time.perf_counter()
+                restore_epoch(g2, payload)
+                recovery_s = time.perf_counter() - t0
+            shutil.rmtree(epoch_dir, ignore_errors=True)
+        return n_events / dt, sink.windows, sink.total, commits, recovery_s
+
+    ons, offs = [], []
+    try:
+        # calibrate the cadence to the measured run length: a smoke-N
+        # run finishes far inside one second, so "1 Hz" would commit
+        # zero epochs.  Running MORE epochs per stream second than the
+        # 1 Hz operating point only over-counts the per-epoch cost, so
+        # a < 5% result here certifies the 1 Hz criterion a fortiori.
+        rate0, _w0, _t0, _c0, _r0 = one(False, 99)
+        dt_off = n_events / rate0
+        interval_used[0] = max(min(interval_s, dt_off / 8), 0.02)
+        for i in range(3):
+            offs.append(one(False, 2 * i))
+            ons.append(one(True, 2 * i + 1))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    rate_off, w_off, tot_off, _c, _r = max(offs, key=lambda r: r[0])
+    rate_on, w_on, tot_on, _c, _r = max(ons, key=lambda r: r[0])
+    assert w_on == w_off and tot_on == tot_off, \
+        "durability plane changed results"
+    commits = max(c for _r8, _w, _t, c, _rs in ons if c is not None)
+    assert commits >= 1, "no epoch committed in the durable lane"
+    recoveries = [rs for _r8, _w, _t, _c, rs in ons if rs is not None]
+    overhead = 1.0 - rate_on / rate_off if rate_off else 0.0
+    summary = {"commits": commits,
+               "epoch_interval_s": round(interval_used[0], 4),
+               "recovery_s": round(min(recoveries), 4) if recoveries
+               else None}
+    return rate_on, rate_off, overhead, w_on, summary
+
+
 def run_reference_arch_baseline(n_events):
     """The honest baseline: identical workload through the native C++
     record-at-a-time engine in the reference's architecture (one thread
@@ -1213,6 +1310,18 @@ def main():
         "windows": w10,
         "overhead_frac": round(ovh10, 4),
         **diag10}
+    # durability-plane overhead (docs/RESILIENCE.md "Exactly-once
+    # epochs"): identical feed with 1 Hz aligned epoch barriers +
+    # manifest commits ON vs OFF, results asserted identical, recovery
+    # time (manifest -> fresh graph) reported.  Acceptance: < 5%
+    # overhead at 1 Hz in this gated config.
+    r11_on, r11_off, ovh11, w11, dur11 = run_checkpoint_overhead(
+        N_EVENTS // 4)
+    configs["11_checkpoint_overhead"] = {
+        "rate": round(r11_on, 1), "rate_no_epochs": round(r11_off, 1),
+        "windows": w11,
+        "overhead_frac": round(ovh11, 4),
+        **dur11}
     for name, c in configs.items():
         n_out = c.get("windows", c.get("records", 0))
         print(f"[bench] {name}: {c['rate']:,.0f} tuples/s "

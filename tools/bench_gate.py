@@ -112,6 +112,16 @@ def measure() -> tuple:
         bench.run_diagnosis_overhead(N_SMALL)
     out["10_diagnosis_feed"] = round(r10_on, 1)
     out["10_undiagnosed_feed"] = round(r10_off, 1)
+    # durability-plane smoke (docs/RESILIENCE.md "Exactly-once
+    # epochs"): the durable lane (aligned 1 Hz epoch barriers +
+    # atomic manifest commits + per-replica snapshots, NO graph-wide
+    # quiesce) must stay within the cliff threshold;
+    # run_checkpoint_overhead itself asserts identical results and at
+    # least one committed epoch, and measures recovery time
+    r11_on, r11_off, _ovh11, _w11, _dur11 = \
+        bench.run_checkpoint_overhead(N_SMALL)
+    out["11_epochs_feed"] = round(r11_on, 1)
+    out["11_no_epochs_feed"] = round(r11_off, 1)
     for q in ("q5", "q7"):
         # per-query warmup: each query's engine ('count'/'max') XLA-
         # compiles on first launch; without this the compile lands in

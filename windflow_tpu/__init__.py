@@ -18,7 +18,8 @@ windflow_gpu.hpp:34-42):
 """
 from .core import (Mode, WinType, OptLevel, RoutingMode, Pattern, WinEvent,
                    OrderingMode, Role, WinOperatorConfig, RuntimeConfig,
-                   ElasticSpec, BasicRecord, TupleBatch, EOS, TriggererCB,
+                   DurabilityConfig, ElasticSpec, BasicRecord, TupleBatch,
+                   EOS, TriggererCB,
                    TriggererTB, Window, StreamArchive, FlatFAT, Iterable,
                    Shipper, RuntimeContext, LocalStorage, Expr, F)
 
@@ -68,6 +69,14 @@ def __getattr__(name):
         "RescaleEvent": "windflow_tpu.elastic",
         "RescaleError": "windflow_tpu.elastic",
         "LoadReport": "windflow_tpu.elastic",
+        # durability plane (durability/; docs/RESILIENCE.md
+        # "Exactly-once epochs")
+        "EpochCoordinator": "windflow_tpu.durability",
+        "EpochStore": "windflow_tpu.durability",
+        "EpochBarrier": "windflow_tpu.durability",
+        "EpochTaggedStore": "windflow_tpu.durability",
+        "run_with_epochs": "windflow_tpu.durability",
+        "restore_epoch": "windflow_tpu.durability",
         # mesh-scale operators + mesh construction (multi-chip plane)
         "KeyFarmMesh": "windflow_tpu.operators.tpu.mesh_farm",
         "PaneFarmMesh": "windflow_tpu.operators.tpu.pane_mesh",

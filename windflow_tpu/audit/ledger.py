@@ -347,8 +347,14 @@ class FlowLedger:
                     if o.audit_cells:
                         sources_emitted += sum(c.sent
                                                for c in o.audit_cells)
+                # durability plane: epoch barriers ride the same outlet
+                # send path (so per-edge books balance by construction)
+                # but are control items, not stream tuples -- the
+                # graph-wide identity subtracts them on both ends
+                sources_emitted -= getattr(n, "epoch_barriers_out", 0)
             elif not n.outlets:
                 sinks_consumed += getattr(n.channel, "gets", 0)
+                sinks_consumed -= getattr(n, "epoch_barriers_in", 0)
             processing += max(0, n.taken - n.done)
             probe = getattr(n.logic, "audit_in_flight", None)
             if probe is not None:

@@ -132,7 +132,12 @@ class FrontierTracker:
                            default=st.wm)
                 depth = getattr(n.channel, "depth", 0) \
                     if n.channel is not None else 0
-                caught_up = depth == 0 and n.taken == n.done
+                # durability plane: items parked in a barrier aligner's
+                # holdback buffer are unprocessed input even though
+                # they were dequeued (depth 0) and never taken
+                aligner = getattr(n, "epochs", None)
+                caught_up = depth == 0 and n.taken == n.done \
+                    and (aligner is None or not aligner.busy)
                 if caught_up and cand > st.wm:
                     st.wm = cand
                     st.wm_t = now

@@ -395,8 +395,35 @@ class AccumulatorBuilder(_BuilderBase):
 class SinkBuilder(_BuilderBase):
     _default_name = "sink"
 
+    def __init__(self, fn):
+        super().__init__(fn)
+        self.exactly_once = None
+
+    def with_exactly_once(self, mode: str = "transactional"):
+        """Exactly-once sink contract under the durability plane
+        (``RuntimeConfig.durability``; docs/RESILIENCE.md):
+
+        * ``'transactional'`` -- effects buffer per epoch; the aligned
+          barrier seals the buffer and the coordinator releases it only
+          after the epoch's manifest committed durably.  A crash
+          discards unreleased effects; the restart regenerates exactly
+          them.
+        * ``'idempotent'`` -- effects apply immediately through an
+          epoch-keyed writer (``write(epoch, item)``, e.g.
+          ``windflow_tpu.durability.EpochTaggedStore``); recovery
+          truncates the writer above the restored epoch.  The contract
+          for side channels keyed by epoch id (the stats / dead-letter
+          surfaces)."""
+        if mode not in ("transactional", "idempotent"):
+            raise ValueError(
+                "with_exactly_once: mode must be 'transactional' or "
+                f"'idempotent', not {mode!r}")
+        self.exactly_once = mode
+        return self
+
     def build(self) -> Sink:
-        return Sink(self.fn, self.parallelism, self.name, self.closing_func)
+        return Sink(self.fn, self.parallelism, self.name,
+                    self.closing_func, exactly_once=self.exactly_once)
 
 
 @_alias_camel

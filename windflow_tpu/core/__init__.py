@@ -1,7 +1,7 @@
 """L1 core abstractions (reference wf/ L1: SURVEY.md §2.1)."""
 from .basic import (Mode, WinType, OptLevel, RoutingMode, Pattern, WinEvent,
                     OrderingMode, Role, WinOperatorConfig, RuntimeConfig,
-                    ElasticSpec,
+                    DurabilityConfig, ElasticSpec,
                     DEFAULT_BATCH_SIZE_TB, current_time_usecs)
 from .tuples import WFRecord, BasicRecord, TupleBatch, EOS
 from .window import TriggererCB, TriggererTB, Window, classify_cb, classify_tb
@@ -17,6 +17,7 @@ from . import win_assign
 __all__ = [
     "Mode", "WinType", "OptLevel", "RoutingMode", "Pattern", "WinEvent",
     "OrderingMode", "Role", "WinOperatorConfig", "RuntimeConfig",
+    "DurabilityConfig",
     "ElasticSpec",
     "DEFAULT_BATCH_SIZE_TB", "current_time_usecs",
     "WFRecord", "BasicRecord", "TupleBatch", "EOS",

@@ -166,6 +166,28 @@ class ElasticSpec:
     target_util: float = 0.75
 
 
+@dataclass(frozen=True)
+class DurabilityConfig:
+    """Exactly-once epoch configuration (durability/;
+    docs/RESILIENCE.md "Exactly-once epochs").
+
+    ``RuntimeConfig.durability = DurabilityConfig(...)`` turns on the
+    epoch coordinator: aligned barrier markers are injected at every
+    source replica each ``epoch_interval_s``, ride the channel planes
+    as control items, and snapshot each replica's state as they pass --
+    WITHOUT stopping the graph.  Each epoch atomically commits
+    {per-replica state, per-source offsets, epoch id} as a manifest
+    under ``path`` (write-temp + fsync + atomic rename), keeping the
+    newest ``retained`` manifests.  An epoch older than
+    ``stall_factor x epoch_interval_s`` without a commit flags the
+    ``Stalled`` gauge (and the doctor verdict)."""
+
+    epoch_interval_s: float = 1.0
+    path: str = "epochs"
+    retained: int = 3
+    stall_factor: float = 5.0
+
+
 @dataclass
 class RuntimeConfig:
     """Global runtime knobs (folds the reference's macro set: README
@@ -286,3 +308,11 @@ class RuntimeConfig:
     # ``ElasticityConfig(enabled=False)`` keeps it off while manual
     # PipeGraph.rescale(...) calls stay available.
     elasticity: Any = None
+    # -- durability plane (durability/; docs/RESILIENCE.md) -------------
+    # DurabilityConfig turning on exactly-once epoch barriers: aligned
+    # snapshot markers injected at sources each epoch_interval_s,
+    # per-replica state captured as they pass (no graph-wide quiesce),
+    # atomically-committed epoch manifests, and the transactional /
+    # idempotent sink contract (SinkBuilder.with_exactly_once).  None
+    # (the default) keeps the pre-durability hot path untouched.
+    durability: Any = None

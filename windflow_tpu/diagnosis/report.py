@@ -67,6 +67,18 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
                    "Queue_depth": last("queue_depth")}
     failures = [e for e in flight
                 if e.get("kind") in ("node_failure", "stall")]
+    dur = stats.get("Durability")
+    durability = None
+    if dur:
+        durability = {
+            "Committed_epoch": int(dur.get("Committed_epoch", 0) or 0),
+            "Epoch_lag_s": float(dur.get("Epoch_lag_s", 0) or 0),
+            "Last_commit_s": float(dur.get("Last_commit_s", 0) or 0),
+            "Commits": int(dur.get("Commits", 0) or 0),
+            "Aborts": int(dur.get("Aborts", 0) or 0),
+            "Stalled": bool(dur.get("Stalled")),
+            "Restored_from": dur.get("Restored_from"),
+        }
     report = {
         "Graph": stats.get("PipeGraph_name", "?"),
         "Schema_version": stats.get("Schema_version"),
@@ -76,6 +88,7 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
         "Anomalies": anomalies,
         "Anomalies_total": diag.get("Anomalies_total", len(anomalies)),
         "Conservation": conservation,
+        "Durability": durability,
         "Hot_keys": hot,
         "History": history,
         "Failures": failures,
@@ -94,6 +107,14 @@ def _verdict(report: dict) -> str:
     cons = report["Conservation"]
     if cons and cons["Violations"]:
         parts.append(f"{cons['Violations']} conservation violation(s)")
+    dur = report.get("Durability")
+    if dur and dur["Stalled"]:
+        # stalled epochs: barriers stopped reaching the sinks (a
+        # wedged operator, a parked source, a dead branch) -- the
+        # recovery point is frozen even though the graph may look live
+        parts.append(f"epochs STALLED (committed "
+                     f"{dur['Committed_epoch']}, oldest uncommitted "
+                     f"{dur['Epoch_lag_s']:.1f}s old)")
     bn = report["Bottleneck"] or {}
     if bn.get("Operator"):
         if bn.get("Verdict") == "input_bound":
@@ -173,6 +194,15 @@ def render_text(report: dict) -> str:
         out.append(f"conservation: balanced={cons['Balanced']} "
                    f"violations={cons['Violations']} "
                    f"final={cons['Final_check']}")
+    dur = report.get("Durability")
+    if dur:
+        restored = dur.get("Restored_from")
+        out.append(f"epochs: committed={dur['Committed_epoch']} "
+                   f"commits={dur['Commits']} aborts={dur['Aborts']} "
+                   f"lag={dur['Epoch_lag_s']:.1f}s "
+                   f"stalled={dur['Stalled']}"
+                   + (f" restored_from={restored}"
+                      if restored is not None else ""))
     hot = report.get("Hot_keys") or []
     if hot:
         out.append("hot keys: " + ", ".join(

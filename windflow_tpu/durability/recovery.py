@@ -1,0 +1,87 @@
+"""Epoch-aware recovery: restart from the last committed epoch
+(docs/RESILIENCE.md "Exactly-once epochs").
+
+``run_with_epochs`` is the durable sibling of
+``utils.checkpoint.run_with_recovery``: each attempt rebuilds the graph
+from the factory, restores every replica's state from the newest
+loadable epoch manifest (sources rewind to the committed offsets --
+their offset IS their snapshot state), and re-runs.  Combined with a
+transactional/idempotent sink, the restart regenerates exactly the
+effects the crashed attempt had not durably committed: end-to-end
+exactly-once, verified online by the conservation ledger balancing in
+the restarted run and offline by the kill-restart-verify chaos suite.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, List, Optional
+
+from ..resilience.errors import NodeFailureError
+from .store import EpochStore
+
+
+def restore_epoch(graph, payload: dict) -> int:
+    """Load a committed epoch manifest into an UNSTARTED, structurally
+    identical graph; returns the number of replicas restored.
+
+    Structure checking and state loading are shared with
+    ``utils.checkpoint.restore_graph`` (``restore_states``): the
+    manifest's stateful-replica names must equal this graph's (names
+    are pre-fusion, so any OptLevel restores) -- a silent partial
+    restore would misdistribute keyed state."""
+    from ..utils.checkpoint import restore_states
+    return restore_states(
+        graph, payload["states"],
+        f"epoch manifest (epoch {payload.get('epoch')})",
+        decode=pickle.loads)
+
+
+def run_with_epochs(graph_factory: Callable[[int], Any],
+                    max_restarts: int = 3,
+                    on_failure: Optional[Callable] = None,
+                    on_restore: Optional[Callable] = None) -> Any:
+    """Run ``graph_factory(attempt)`` to completion with epoch-aware
+    restarts.  Every graph the factory builds must carry the SAME
+    ``RuntimeConfig.durability`` (same manifest path).
+
+    On a retryable failure (``NodeFailureError`` -- replica death,
+    stall, injected torn commit) the latest loadable epoch manifest is
+    restored into a freshly built graph: replica state reloads,
+    sources rewind to the committed offsets, and uncommitted sink
+    output is discarded with the dead graph.  ``on_restore(graph,
+    epoch, payload)`` runs after a successful restore -- e.g. to
+    ``truncate_above(epoch)`` an idempotent sink's store.
+    ``on_failure(attempt, error, graph)`` observes each failed attempt;
+    all failures attach to the finally raised error as
+    ``attempt_history``."""
+    attempt = 0
+    history: List[BaseException] = []
+    while True:
+        g = graph_factory(attempt)
+        dcfg = getattr(g.config, "durability", None)
+        if dcfg is None:
+            raise ValueError(
+                "run_with_epochs: the factory's graphs must set "
+                "RuntimeConfig.durability (use run_with_recovery for "
+                "quiescent-checkpoint restarts)")
+        store = EpochStore(dcfg.path, dcfg.retained)
+        epoch, payload = store.latest(flight=g.flight)
+        if epoch is not None:
+            n = restore_epoch(g, payload)
+            g.flight.record("epoch_restore", epoch=epoch, replicas=n,
+                            offsets=payload.get("offsets", {}),
+                            attempt=attempt)
+            g._epoch_restored = epoch
+            if on_restore is not None:
+                on_restore(g, epoch, payload)
+        try:
+            g.run()
+            return g
+        except NodeFailureError as e:
+            history.append(e)
+            if on_failure is not None:
+                on_failure(attempt, e, g)
+            attempt += 1
+            if attempt > max_restarts:
+                e.attempt_history = history
+                raise

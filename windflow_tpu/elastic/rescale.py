@@ -358,6 +358,10 @@ def _grow(graph, handle: ElasticHandle, old_nodes: List[RtNode],
             # audit plane: delivery books + put faults + sketches on
             # the new replica's own outlets, exactly as at start()
             graph.auditor.attach_node(node)
+        if graph.durability is not None:
+            # durability plane: the aligner must exist BEFORE the
+            # replica thread starts, exactly as the auditor's books
+            graph.durability.attach_node(node)
         node.stats = graph.stats.register(handle.name, str(idx))
         graph._cancel.register(node.channel)
     handle.pipe.nodes.extend(added)

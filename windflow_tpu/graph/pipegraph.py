@@ -89,6 +89,10 @@ class PipeGraph:
         # path attribution, bottleneck walk, gauge history + regression
         # bands, built at start() when RuntimeConfig.diagnosis is on
         self.diagnosis = None
+        # durability plane (durability/; docs/RESILIENCE.md): aligned
+        # epoch barriers + manifest commits + exactly-once sink
+        # release, built at start() when RuntimeConfig.durability is set
+        self.durability = None
 
     # -- construction ------------------------------------------------------
     def _new_pipe(self) -> MultiPipe:
@@ -353,10 +357,22 @@ class PipeGraph:
             from ..diagnosis import DiagnosisPlane
             self.diagnosis = DiagnosisPlane(self)
             self.stats.set_topology(self.diagnosis.edges)
+        # durability plane (durability/; docs/RESILIENCE.md): the epoch
+        # coordinator + per-node barrier aligners/injectors.  AFTER the
+        # audit books (barriers ride Outlet.send_to, so per-edge
+        # delivery books count them symmetrically) and fault binding
+        # (crash_at_epoch fires through the bound NodeFaults), BEFORE
+        # any replica thread runs
+        if self.config.durability is not None:
+            from ..durability import EpochCoordinator
+            self.durability = EpochCoordinator(self)
+            self.durability.attach()
         for n in self._all_nodes():
             n.start()
         if self.auditor is not None:
             self.auditor.start()
+        if self.durability is not None:
+            self.durability.start()
         # watchdog AFTER the replica threads: it treats "no node alive"
         # as graph completion, so starting it first would let it exit
         # before the first node ever ran
@@ -420,6 +436,11 @@ class PipeGraph:
             self._controller.stop()
         if self._watchdog is not None:
             self._watchdog.stop()
+        if self.durability is not None:
+            # a failed/cancelled run strands its in-flight epochs;
+            # stop() records them as epoch_abort next to the failure
+            self.durability.stop(
+                clean=not errors and not self._cancel.cancelled)
         if self.auditor is not None:
             # final ledger closure BEFORE the monitor's last snapshot
             # and the stats dump, so both carry the settled books.
@@ -544,7 +565,11 @@ class PipeGraph:
                        if n.is_alive())
             empty = all(n.channel.qsize() == 0 for n in consumers
                         if n.is_alive())
-            if idle and empty and total_done == last_done:
+            # durability plane: items parked in a barrier aligner's
+            # holdback buffer are in flight even though taken == done
+            aligned = all(n.epochs is None or not n.epochs.busy
+                          for n in consumers if n.is_alive())
+            if idle and empty and aligned and total_done == last_done:
                 stable += 1
             else:
                 stable = 0
@@ -561,6 +586,13 @@ class PipeGraph:
         if not self._started or self._ended:
             raise RuntimeError("quiesce() needs a running graph")
         deadline = time.monotonic() + timeout
+        if self.durability is not None:
+            # serialize with the epoch plane FIRST: an epoch held open
+            # across the source pause could never align (parked sources
+            # inject no barriers) and its holdback buffers would defeat
+            # the drain.  hold_epochs stops the cadence and waits for
+            # in-flight epochs to commit while the graph keeps flowing.
+            self.durability.hold_epochs(timeout)
         self._pause_ctl.request_pause()
         # wait for every still-running source to ack the pause
         while True:
@@ -571,6 +603,8 @@ class PipeGraph:
                 break
             if time.monotonic() > deadline:
                 self._pause_ctl.resume()
+                if self.durability is not None:
+                    self.durability.release_epochs()
                 raise RuntimeError("live checkpoint: sources failed to "
                                    "pause (timeout)")
             time.sleep(0.002)
@@ -587,10 +621,14 @@ class PipeGraph:
         except BaseException:
             # a failed drain must not leave the sources parked forever
             self._pause_ctl.resume()
+            if self.durability is not None:
+                self.durability.release_epochs()
             raise
 
     def resume(self) -> None:
         self._pause_ctl.resume()
+        if self.durability is not None:
+            self.durability.release_epochs()
 
     # -- elastic scaling plane (elastic/; docs/ELASTIC.md) --------------
     def rescale(self, operator: str, new_parallelism: int,
@@ -619,9 +657,26 @@ class PipeGraph:
                     f"registered: {sorted(self.elastic)}")
             handle = matches[0]
         from ..elastic.rescale import rescale_operator
-        with self._rescale_lock:
-            event = rescale_operator(self, handle, new_parallelism,
-                                     trigger, timeout)
+        dur = self.durability
+        if dur is not None:
+            # durability plane: barriers and rescales serialize PER
+            # EPOCH, not under one global lock -- stop the epoch
+            # cadence, let in-flight epochs commit while the graph
+            # keeps flowing, then rescale inside the gap
+            dur.hold_epochs(timeout)
+        try:
+            with self._rescale_lock:
+                event = rescale_operator(self, handle, new_parallelism,
+                                         trigger, timeout)
+            if dur is not None:
+                # refresh aligner producer counts for the rewired
+                # channel set (retired producers already announced
+                # themselves with final barriers) and give the new
+                # replicas aligners before the cadence resumes
+                dur.rewire()
+        finally:
+            if dur is not None:
+                dur.release_epochs()
         if event is not None:
             self.flight.record("rescale", **event.to_dict())
         return event
@@ -687,12 +742,31 @@ class PipeGraph:
         return build_report(stats, self.flight.snapshot())
 
     def live_checkpoint(self, path: str, timeout: float = 120.0) -> int:
-        """Mid-stream snapshot: quiesce, save every replica's state
-        (including ordering/K-slack collector buffers), resume.
+        """Mid-stream snapshot to a ``restore_graph``-compatible file.
+
+        With the durability plane on (``RuntimeConfig.durability``)
+        this is NON-STOP: it forces one aligned epoch and waits for its
+        commit -- no source pause, no drain, the graph keeps emitting
+        throughout -- then mirrors the committed states to ``path``.
+        Without it, the legacy barrier applies: quiesce (pause sources,
+        drain channels and in-flight device batches), snapshot, resume.
         Returns the number of replicas captured.  Restores pair with
-        at-least-once source replay from the checkpoint point."""
-        from ..utils.checkpoint import graph_state
+        source replay from the captured offsets."""
         import pickle
+        from ..utils.checkpoint import write_snapshot
+        if not self._started or self._ended:
+            # both paths need a live graph: the legacy barrier pauses
+            # running sources, and a forced epoch can only commit while
+            # the coordinator thread and the sinks are alive
+            raise RuntimeError("live_checkpoint() needs a running graph")
+        if self.durability is not None:
+            epoch, blobs = self.durability.checkpoint_now(timeout)
+            states = {name: pickle.loads(b) for name, b in blobs.items()}
+            write_snapshot(path, states, epoch=epoch)
+            self.flight.record("checkpoint_epoch", path=path, epoch=epoch,
+                               replicas=len(states), non_stop=True)
+            return len(states)
+        from ..utils.checkpoint import graph_state
         # serialize with elastic rescales: SourcePauseControl is a
         # non-counting boolean, so a concurrent rescale's resume()
         # would un-park sources mid-snapshot (and vice versa)
@@ -700,8 +774,7 @@ class PipeGraph:
             self.quiesce(timeout)
             try:
                 state = graph_state(self)
-                with open(path, "wb") as f:
-                    pickle.dump(state, f)
+                write_snapshot(path, state)
             finally:
                 self.resume()
         self.flight.record("checkpoint_epoch", path=path,

@@ -23,10 +23,11 @@ from ..telemetry.histogram import LogHistogram
 # Stats-JSON schema version (the top-level ``Schema_version`` field).
 # 3 = the diagnosis-plane layout (adds Topology / Diagnosis / History /
 # optional Flight on top of the PR 7 telemetry and PR 9 audit blocks).
+# 4 = adds the optional Durability block (epoch coordinator gauges).
 # Readers (doctor CLI, dashboard /explain, tests) must tolerate MISSING
 # blocks rather than dispatch on this number: older dumps carry no
 # version field at all, and every block is optional by contract.
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 
 @dataclass
@@ -231,6 +232,10 @@ class GraphStats:
         self.topology: Optional[List[List[str]]] = None
         self.diagnosis: Optional[dict] = None
         self.history: Optional[dict] = None
+        # durability plane (durability/; docs/RESILIENCE.md): the
+        # latest epoch-coordinator gauges (committed epoch, lag,
+        # commit wall time, stall flag), published per commit/tick
+        self.durability: Optional[dict] = None
 
     def register(self, operator_name: str, replica_id: str) -> StatsRecord:
         rec = StatsRecord(operator_name, replica_id)
@@ -294,6 +299,12 @@ class GraphStats:
             self.diagnosis = block
             self.history = history
 
+    def set_durability(self, block: dict) -> None:
+        """Publish the epoch coordinator's latest gauges
+        (durability/coordinator.py, per commit/tick)."""
+        with self.lock:
+            self.durability = block
+
     def to_json(self, dropped_tuples: int = 0,
                 dead_letter_tuples: int = 0,
                 flight_events: Optional[List[dict]] = None) -> str:
@@ -330,6 +341,7 @@ class GraphStats:
             topology = self.topology
             diagnosis = self.diagnosis
             history = self.history
+            durability = self.durability
             latency_e2e = None
             trace_records: List[dict] = []
             if self.histograms:
@@ -390,6 +402,11 @@ class GraphStats:
             "Topology": {"Edges": topology} if topology else None,
             "Diagnosis": diagnosis,
             "History": history,
+            # durability plane (durability/; docs/RESILIENCE.md):
+            # epoch-coordinator gauges -- committed/begun epoch ids,
+            # lag of the oldest uncommitted epoch, last commit wall
+            # time, stall flag; None with the plane disabled
+            "Durability": durability,
             "Memory_usage_KB": get_mem_usage_kb(),
             "Operator_number": len(ops),
             "Operators": ops,

@@ -314,11 +314,28 @@ class Sink(_BasicOp):
     base_arity = 1
 
     def __init__(self, fn, parallelism=1, name="sink", closing_func=None,
-                 keyed=False):
+                 keyed=False, exactly_once=None):
         super().__init__(fn, parallelism, name, closing_func, keyed,
                          Pattern.SINK)
+        # exactly-once sink contract (durability/transaction.py;
+        # docs/RESILIENCE.md): 'transactional' buffers effects per
+        # epoch and releases on durable commit; 'idempotent' applies
+        # immediately through an epoch-keyed writer
+        if exactly_once not in (None, "transactional", "idempotent"):
+            raise ValueError(
+                "exactly_once must be None, 'transactional' or "
+                f"'idempotent', not {exactly_once!r}")
+        self.exactly_once = exactly_once
 
     def _make_logic(self, i, n=None):
+        if self.exactly_once == "transactional":
+            from ..durability.transaction import TransactionalSinkLogic
+            return TransactionalSinkLogic(self.fn, n or self.parallelism,
+                                          i, self.closing_func)
+        if self.exactly_once == "idempotent":
+            from ..durability.transaction import IdempotentSinkLogic
+            return IdempotentSinkLogic(self.fn, n or self.parallelism,
+                                       i, self.closing_func)
         return SinkLogic(self.fn, n or self.parallelism, i,
                          self.closing_func)
 

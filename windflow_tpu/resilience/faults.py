@@ -103,20 +103,35 @@ class _PutRule:
         self.action = action
 
 
+class _EpochCrashRule:
+    """Barrier-window crash (durability/): the replica dies while
+    taking its epoch cut for ``epoch`` -- deterministic on the epoch
+    id, so it cannot drift with stream timing like a tuple clock."""
+
+    __slots__ = ("node_substr", "epoch", "message")
+
+    def __init__(self, node_substr: str, epoch: int, message: str):
+        self.node_substr = node_substr
+        self.epoch = epoch
+        self.message = message
+
+
 class NodeFaults:
     """Per-replica fault state bound at graph start (own counters +
     own seeded RNG, so injection is deterministic per node)."""
 
-    __slots__ = ("node_name", "crash", "delays", "put_rules", "_rng",
-                 "_emits", "_puts")
+    __slots__ = ("node_name", "crash", "delays", "put_rules",
+                 "epoch_crashes", "_rng", "_emits", "_puts")
 
     def __init__(self, node_name: str, crash: Optional[_CrashRule],
                  delays: List[_DelayRule], seed: int,
-                 put_rules: Optional[List[_PutRule]] = None):
+                 put_rules: Optional[List[_PutRule]] = None,
+                 epoch_crashes: Optional[List[_EpochCrashRule]] = None):
         self.node_name = node_name
         self.crash = crash
         self.delays = delays
         self.put_rules = put_rules or []
+        self.epoch_crashes = epoch_crashes or []
         self._rng = random.Random((seed, node_name).__repr__())
         self._emits = 0
         self._puts = 0
@@ -127,6 +142,15 @@ class NodeFaults:
         if c is not None and taken == c.at_tuple:
             raise InjectedFailure(
                 f"{c.message} (node {self.node_name}, tuple {taken})")
+
+    def on_epoch(self, epoch: int) -> None:
+        """Called by the durability plane's epoch cut (barrier aligned,
+        before the snapshot) with the epoch id."""
+        for r in self.epoch_crashes:
+            if epoch == r.epoch:
+                raise InjectedFailure(
+                    f"{r.message} (node {self.node_name}, "
+                    f"epoch {epoch})")
 
     def before_put(self) -> None:
         """Called before each downstream emission."""
@@ -160,6 +184,10 @@ class FaultPlan:
         self._crashes: List[_CrashRule] = []
         self._delays: List[_DelayRule] = []
         self._put_rules: List[_PutRule] = []
+        self._epoch_crashes: List[_EpochCrashRule] = []
+        # epochs whose manifest commit is torn (read by the
+        # EpochCoordinator; graph-global, no node binding)
+        self.torn_commit_epochs: set = set()
         self._native_armed = False
 
     # -- declaration (chainable) --------------------------------------
@@ -196,6 +224,33 @@ class FaultPlan:
         self._put_rules.append(_PutRule(node_substr, at_put, "dup"))
         return self
 
+    def crash_at_epoch(self, node_substr: str, epoch: int,
+                       message: str = "injected barrier-window crash"
+                       ) -> "FaultPlan":
+        """The matching replica dies INSIDE the barrier window of
+        ``epoch`` (durability/: after alignment, before the snapshot)
+        -- deterministic and seeded like ``crash_replica``, but keyed
+        to the epoch id so barrier-window crashes cannot drift with
+        stream timing.  Fires on fused-away operators too (the cut
+        walks every segment's fault state)."""
+        if epoch < 1:
+            raise ValueError("epoch ids are 1-based")
+        self._epoch_crashes.append(
+            _EpochCrashRule(node_substr, epoch, message))
+        return self
+
+    def torn_commit(self, epoch: int) -> "FaultPlan":
+        """The manifest commit of ``epoch`` is torn: a truncated
+        payload lands at the FINAL manifest path (simulating a
+        non-atomic writer dying mid-commit) and the graph dies with an
+        injected failure -- the restarted run's tolerant manifest
+        reader must skip the damage and fall back to the previous
+        committed epoch."""
+        if epoch < 1:
+            raise ValueError("epoch ids are 1-based")
+        self.torn_commit_epochs.add(int(epoch))
+        return self
+
     def fail_native_build(self) -> "FaultPlan":
         """Force the native toolchain probe to fail from now until
         ``deactivate()`` (or context-manager exit)."""
@@ -220,10 +275,12 @@ class FaultPlan:
                       if c.node_substr in node_name), None)
         delays = [d for d in self._delays if d.node_substr in node_name]
         puts = [p for p in self._put_rules if p.node_substr in node_name]
-        if crash is None and not delays and not puts:
+        epochs = [e for e in self._epoch_crashes
+                  if e.node_substr in node_name]
+        if crash is None and not delays and not puts and not epochs:
             return None
         return NodeFaults(node_name, crash, delays, self.seed,
-                          put_rules=puts)
+                          put_rules=puts, epoch_crashes=epochs)
 
     # -- context manager ----------------------------------------------
     def __enter__(self) -> "FaultPlan":

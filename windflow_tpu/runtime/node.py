@@ -690,6 +690,18 @@ class RtNode(threading.Thread):
         # outlet-level put faults (drop_put/dup_put): resolved once per
         # thread in run(); forces the per-item emission fallback
         self._outlet_put_faults = False
+        # durability plane (durability/; docs/RESILIENCE.md): the graph
+        # EpochCoordinator (None = epochs off -> zero per-item cost),
+        # the per-consumer barrier aligner, and the barrier counters
+        # the ledger's graph-wide roll-up subtracts (per-edge books
+        # count barriers symmetrically; the sources/sinks totals must
+        # not)
+        self.epoch_coord = None
+        self.epochs = None
+        self.epoch_barriers_in = 0
+        self.epoch_barriers_out = 0
+        self._accepts_chunks = False  # resolved per thread (durable path)
+        self._sync_emit = True
 
     def bind_outlet_faults(self) -> None:
         """Propagate put-level fault state (FaultPlan drop_put /
@@ -843,6 +855,38 @@ class RtNode(threading.Thread):
             # one amortized observation per batch, not per tuple
             stats.observe((_time.perf_counter() - t0) * 1e6 / processed)
 
+    def _process_one(self, cid: int, item: Any) -> None:
+        """One guarded svc call: the per-item consume body, factored
+        out for the durability plane's dispatch path (barrier-aware
+        routing + the aligner's held-item replay).  Must stay
+        semantically identical to the inline loop below -- the inline
+        copy exists so the epochs-off hot path pays no extra call."""
+        if not self._accepts_chunks and isinstance(item, SynthChunk):
+            item = item.materialize(self.pool)  # plane boundary
+        self.taken += 1
+        if self.faults is not None:
+            self.faults.on_tuple(self.taken)  # may raise InjectedFailure
+        tele = self.telemetry
+        ctx = None if tele is None else getattr(item, "trace", None)
+        if ctx is not None:
+            t_in = _time.perf_counter()
+            rec = self._hop_rec
+            if rec is not None and rec.residency_hist is not None:
+                rec.residency_hist.observe((t_in - ctx.last) * 1e6)
+            if self._sync_emit:
+                self._live_trace = ctx
+        try:
+            self._svc_guarded(item, cid)
+        finally:
+            self.done += 1
+            if ctx is not None:
+                self._live_trace = None
+                t_done = _time.perf_counter()
+                if not self._fused:
+                    ctx.hop(self.name, t_in, t_done)
+                    if self._terminal:
+                        tele.close(ctx, self._e2e_rec, t_done)
+
     def _consume_loop(self) -> None:
         # logics with an idle_tick hook (time-bounded device launches on
         # stalled streams) take timed gets so the tick fires without input
@@ -853,10 +897,16 @@ class RtNode(threading.Thread):
         pool = self.pool
         get_many = getattr(channel, "get_many", None)
         # buffered emissions require the logic's emits to happen inside
-        # the svc call (sync_emit); the async window engines opt out
+        # the svc call (sync_emit); the async window engines opt out.
+        # The durability plane opts out too: the epoch cut must emit
+        # (fence results, forward the barrier) in stream order, which
+        # buffered emission runs would reorder around the barrier.
         sync_emit = getattr(self.logic, "sync_emit", True)
-        buffered = get_many is not None and sync_emit
+        aligner = self.epochs
+        buffered = get_many is not None and sync_emit and aligner is None
         tele = self.telemetry
+        self._accepts_chunks = accepts_chunks
+        self._sync_emit = sync_emit
         timeout = 0.025 if tick else None
         while True:
             if get_many is not None:
@@ -874,6 +924,15 @@ class RtNode(threading.Thread):
                 break
             if buffered and len(got) > 1:
                 self._svc_batch(got, accepts_chunks, faults, pool)
+                continue
+            if aligner is not None:
+                # durable dispatch: barriers route to the aligner
+                # (alignment, epoch cut, holdback replay); everything
+                # else takes the factored per-item body
+                process = self._process_one
+                for cid, item in got:
+                    if not aligner.offer(cid, item, process):
+                        process(cid, item)
                 continue
             for cid, item in got:
                 if not accepts_chunks and isinstance(item, SynthChunk):
@@ -939,6 +998,17 @@ class RtNode(threading.Thread):
             if self.channel is not None:
                 self._consume_loop()
             self.logic.eos_flush(self._emit)
+            if self.epoch_coord is not None:
+                # durability plane: hand the coordinator this replica's
+                # final state (it backfills epochs this node will never
+                # cut for) and tell downstream aligners no further
+                # barriers come from here -- BEFORE flush_eos closes
+                # the producer slots
+                from ..durability.barrier import (broadcast_final,
+                                                  capture_states)
+                self.epoch_coord.node_finished(self.name,
+                                               capture_states(self))
+                broadcast_final(self)
             if self.stats is not None:
                 self.stats.set_terminated()
             term = getattr(self.logic, "set_segments_terminated", None)
@@ -981,9 +1051,14 @@ class SourceLoopLogic(NodeLogic):
 
     ``pause_control`` (a SourcePauseControl, attached by
     PipeGraph.start) gates every generation step so a live checkpoint
-    can halt production at a step boundary."""
+    can halt production at a step boundary.  ``epoch_injector``
+    (durability/barrier.py, attached by the EpochCoordinator) injects
+    aligned epoch barriers at the same boundaries -- BEFORE the pause
+    gate, so an epoch held open can never deadlock against a parked
+    source (PipeGraph.quiesce drains epochs before pausing)."""
 
     pause_control = None
+    epoch_injector = None
 
     def __init__(self, step: Callable[[Callable[[Any], None]], bool]):
         self.step = step
@@ -993,6 +1068,9 @@ class SourceLoopLogic(NodeLogic):
 
     def eos_flush(self, emit):
         while True:
+            inj = self.epoch_injector
+            if inj is not None:
+                inj.maybe_inject()
             ctl = self.pause_control
             if ctl is not None:
                 ctl.gate()

@@ -28,6 +28,30 @@ from ..resilience.cancel import GraphCancelled
 
 _EOS_SENTINEL = object()
 
+
+class EpochBarrier:
+    """Aligned-epoch barrier marker (durability/; docs/RESILIENCE.md
+    "Exactly-once epochs") -- the channel-plane control item of the
+    Chandy-Lamport-style snapshot protocol (Carbone et al., Flink's
+    aligned barriers).  Injected at source replicas by the epoch
+    coordinator, broadcast to every outlet destination, and consumed by
+    the per-node aligners (durability/barrier.py) -- it never reaches
+    operator ``svc``.  Travels through both channel planes as an
+    ordinary item, so per-edge delivery books stay balanced by
+    construction.  ``final=True`` is the end-of-stream variant a node
+    broadcasts before closing its outlets: it tells downstream aligners
+    this producer will inject no further epochs."""
+
+    __slots__ = ("epoch", "final")
+
+    def __init__(self, epoch: int, final: bool = False):
+        self.epoch = epoch
+        self.final = final
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return ("EpochBarrier(final)" if self.final
+                else f"EpochBarrier({self.epoch})")
+
 # returned by get(timeout=...) when the wait elapses: distinct from
 # None (which means every producer closed)
 CHANNEL_TIMEOUT = object()

@@ -234,6 +234,37 @@ def render_openmetrics(apps: dict) -> str:
                 f"windflow_bottleneck_score"
                 f"{_labels(**lab, operator=bn['Operator'], verdict=bn.get('Verdict', ''))} "
                 f"{float(bn.get('Score', 0) or 0)}")
+    # durability plane (durability/; docs/RESILIENCE.md): epoch
+    # coordinator gauges -- absent entirely when the plane is off
+    family("windflow_epoch", "gauge",
+           "last durably committed epoch id")
+    for rep, lab in per_graph():
+        dur = rep.get("Durability") or {}
+        if dur:
+            out.append(f"windflow_epoch{_labels(**lab)} "
+                       f"{int(dur.get('Committed_epoch', 0) or 0)}")
+    family("windflow_epoch_lag_seconds", "gauge",
+           "age of the oldest uncommitted epoch (0 when current)")
+    for rep, lab in per_graph():
+        dur = rep.get("Durability") or {}
+        if dur:
+            out.append(f"windflow_epoch_lag_seconds{_labels(**lab)} "
+                       f"{float(dur.get('Epoch_lag_s', 0) or 0)}")
+    family("windflow_epoch_commit_seconds", "gauge",
+           "wall time of the last manifest commit + sink release")
+    for rep, lab in per_graph():
+        dur = rep.get("Durability") or {}
+        if dur:
+            out.append(f"windflow_epoch_commit_seconds{_labels(**lab)} "
+                       f"{float(dur.get('Last_commit_s', 0) or 0)}")
+    family("windflow_epoch_stalled", "gauge",
+           "1 while the oldest uncommitted epoch exceeds the stall "
+           "threshold")
+    for rep, lab in per_graph():
+        dur = rep.get("Durability") or {}
+        if dur:
+            out.append(f"windflow_epoch_stalled{_labels(**lab)} "
+                       f"{1 if dur.get('Stalled') else 0}")
     family("windflow_e2e_latency_seconds", "histogram",
            "traced source-to-sink latency")
     for rep, lab in per_graph():

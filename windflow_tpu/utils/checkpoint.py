@@ -19,9 +19,17 @@ Scope and contract:
 from __future__ import annotations
 
 import pickle
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from ..graph.pipegraph import NodeFailureError
+
+# snapshot-file header (the stats-JSON Schema_version contract applied
+# to pickled snapshots): save_graph stamps every file; restore_graph
+# tolerates header-less legacy files but rejects foreign, newer-schema
+# or truncated ones with an actionable error instead of an unpickling
+# crash mid-restore
+SNAPSHOT_MAGIC = "windflow-graph-state"
+SNAPSHOT_SCHEMA = 1
 
 
 def _is_stateful(logic) -> bool:
@@ -60,25 +68,56 @@ def graph_state(graph) -> Dict[str, Any]:
     return out
 
 
+def write_snapshot(path: str, states: Dict[str, Any],
+                   epoch: Optional[int] = None) -> None:
+    """Persist a state map crash-safely: schema/epoch header, then
+    write-temp + fsync + atomic rename (durability/store.py) -- a crash
+    mid-write can no longer leave a truncated pickle at ``path`` that
+    poisons every subsequent restart."""
+    from ..durability.store import atomic_write_bytes
+    payload = {"magic": SNAPSHOT_MAGIC, "schema": SNAPSHOT_SCHEMA,
+               "epoch": epoch, "states": states}
+    atomic_write_bytes(path, pickle.dumps(
+        payload, protocol=pickle.HIGHEST_PROTOCOL))
+
+
 def save_graph(graph, path: str) -> None:
-    with open(path, "wb") as f:
-        pickle.dump(graph_state(graph), f)
+    write_snapshot(path, graph_state(graph))
 
 
-def restore_graph(graph, path: str) -> int:
-    """Load state into a structurally identical graph (same operator
-    names/parallelisms).  Returns the number of replicas restored.
+def read_snapshot(path: str) -> Dict[str, Any]:
+    """Tolerant snapshot loader: stamped files validate their header
+    (foreign magic / newer schema / truncation raise an actionable
+    RuntimeError naming the file, via the validators shared with the
+    epoch-manifest reader); header-less legacy files -- a plain
+    pickled state map -- still load."""
+    from ..durability.store import load_pickle, validate_header
+    payload = load_pickle(path, "graph snapshot")
+    if isinstance(payload, dict) and "magic" in payload:
+        validate_header(payload, path, SNAPSHOT_MAGIC, SNAPSHOT_SCHEMA,
+                        "graph snapshot")
+        return payload["states"]
+    if not isinstance(payload, dict):
+        raise RuntimeError(
+            f"{path!r} is not a windflow graph snapshot")
+    return payload  # legacy header-less state map
 
-    Raises BEFORE loading anything if the snapshot's stateful-node
-    names differ from this graph's: in either direction the resume
-    would silently run with misdistributed window state (e.g. an
-    N-replica farm snapshot into a coalesced single-engine lowering,
-    or vice versa).  Which nodes are stateful is determined by the
-    graph structure, not by stream data, so set equality is the
-    structure check."""
+
+def restore_states(graph, states: Dict[str, Any], describe: str,
+                   decode=None) -> int:
+    """Load a state map into a structurally identical graph, shared by
+    ``restore_graph`` and the epoch-manifest restore
+    (durability/recovery.py).  Returns the number of replicas restored.
+
+    Raises BEFORE loading anything if the map's stateful-node names
+    differ from this graph's: in either direction the resume would
+    silently run with misdistributed window state (e.g. an N-replica
+    farm snapshot into a coalesced single-engine lowering, or vice
+    versa).  Which nodes are stateful is determined by the graph
+    structure, not by stream data, so set equality is the structure
+    check.  ``decode`` maps each stored entry to the ``load_state``
+    argument (the manifest path stores pickled blobs)."""
     from ..graph.fuse import iter_logics
-    with open(path, "rb") as f:
-        states = pickle.load(f)
     loadable = {}
     for name, logic in iter_logics(graph):
         if _is_stateful(logic):
@@ -87,13 +126,21 @@ def restore_graph(graph, path: str) -> int:
     missing = set(loadable) - set(states)
     if extra or missing:
         raise RuntimeError(
-            f"snapshot/graph structure mismatch (e.g. different "
-            f"parallelism or coalesce setting than at save time): "
+            f"{describe}/graph structure mismatch (e.g. different "
+            "parallelism or coalesce setting than at save time): "
             f"snapshot-only nodes {sorted(extra)}, "
             f"graph-only nodes {sorted(missing)}; nothing was restored")
     for name, logic in loadable.items():
-        logic.load_state(states[name])
+        st = states[name]
+        logic.load_state(decode(st) if decode is not None else st)
     return len(loadable)
+
+
+def restore_graph(graph, path: str) -> int:
+    """Load a snapshot file into a structurally identical graph (same
+    operator names/parallelisms); returns the replicas restored."""
+    return restore_states(graph, read_snapshot(path),
+                          f"snapshot {path!r}")
 
 
 def run_with_recovery(graph_factory, checkpoint_path: str,
