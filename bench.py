@@ -1022,6 +1022,79 @@ def run_checkpoint_overhead(n_events, interval_s=1.0):
     return rate_on, rate_off, overhead, w_on, summary
 
 
+def bench12_build(g):
+    """Worker-side build of config #12 (imported by the distributed
+    worker processes -- keep it a pure function of env knobs): the Q5
+    shuffle workload, host-lane engine, bids crossing a KEYBY edge."""
+    from windflow_tpu.models.nexmark import build_q5_hot_items
+    n = int(os.environ["WINDFLOW_BENCH12_N"])
+    windows = {"n": 0}
+
+    def sink(item):
+        if item is None:
+            return
+        try:
+            windows["n"] += len(item)
+        except TypeError:
+            windows["n"] += 1
+
+    build_q5_hot_items(g, n, 8192, 4096, sink, n_auctions=1000,
+                       batch_size=1 << 18, device_batch=DEVICE_BATCH,
+                       parallelism=2, placement="host")
+
+
+def bench12_config(worker_id):
+    import windflow_tpu as wf
+    # the source emits a few hundred LARGE batches, so the default
+    # 1-in-128 item sampling would start ~no traces; 1-in-2 batches
+    # still stamps only per batch (cheap) and feeds the p50/p99 readout
+    return wf.RuntimeConfig(tracing=True, trace_sample=2)
+
+
+def run_distributed_shuffle(n_events):
+    """Config #12: one PipeGraph across 2 worker processes, the KEYBY
+    edge carried by the credit-backpressured shuffle transport
+    (distributed/; docs/DISTRIBUTED.md) vs the identical build in one
+    process.  Conservation is asserted end to end (per-worker ledgers
+    + the cross-process wire identity) and the merged traced e2e
+    p50/p99 is reported."""
+    import windflow_tpu as wf
+    from windflow_tpu.diagnosis.report import build_report
+    from windflow_tpu.distributed.runtime import run_distributed
+    os.environ["WINDFLOW_BENCH12_N"] = str(n_events)
+    # 1-process lane: same build, same traced config
+    g = wf.PipeGraph("bench12_local", config=bench12_config(0))
+    bench12_build(g)
+    t0 = time.perf_counter()
+    g.run()
+    rate_1p = n_events / (time.perf_counter() - t0)
+    # 2-process lane (includes worker spawn: the honest wall clock)
+    t0 = time.perf_counter()
+    report = run_distributed(bench12_build, n_workers=2,
+                             config_fn=bench12_config,
+                             graph_name="bench12",
+                             workdir="log/bench12", timeout_s=900.0)
+    rate_2p = n_events / (time.perf_counter() - t0)
+    merged = report["merged"]
+    wire_rows = (merged.get("Wire") or {}).get("Edges") or []
+    conserved = (bool((merged.get("Wire") or {}).get("Balanced"))
+                 and bool((merged.get("Conservation") or {})
+                          .get("Edges_balanced"))
+                 and bool((merged.get("Conservation") or {})
+                          .get("Final_check")))
+    assert conserved, \
+        f"distributed shuffle lost tuples: {merged.get('Wire')}"
+    attr = build_report(merged).get("Attribution") or {}
+    summary = {
+        "wire_tuples": sum(r.get("tuples_sent", 0) for r in wire_rows),
+        "wire_edges": len(wire_rows),
+        "latency_p50_ms": attr.get("E2e_p50_ms"),
+        "latency_p99_ms": attr.get("E2e_p99_ms"),
+        "wire_class_share": (attr.get("Classes") or {}).get("wire"),
+    }
+    return rate_2p, rate_1p, conserved, summary
+
+
 def run_reference_arch_baseline(n_events):
     """The honest baseline: identical workload through the native C++
     record-at-a-time engine in the reference's architecture (one thread
@@ -1322,6 +1395,18 @@ def main():
         "windows": w11,
         "overhead_frac": round(ovh11, 4),
         **dur11}
+    # distributed runtime plane (distributed/; docs/DISTRIBUTED.md):
+    # the Q5 shuffle across 2 worker processes over the credit-
+    # backpressured wire vs one process -- conservation asserted
+    # (per-worker ledgers + cross-process wire identity), merged
+    # traced p50/p99 reported
+    r12_2p, r12_1p, cons12, dist12 = run_distributed_shuffle(
+        N_EVENTS // 4)
+    configs["12_distributed_shuffle"] = {
+        "rate": round(r12_2p, 1), "rate_1proc": round(r12_1p, 1),
+        "vs_1proc": round(r12_2p / r12_1p, 2) if r12_1p else None,
+        "tuples_conserved": cons12,
+        **dist12}
     for name, c in configs.items():
         n_out = c.get("windows", c.get("records", 0))
         print(f"[bench] {name}: {c['rate']:,.0f} tuples/s "

@@ -1,0 +1,963 @@
+"""Distributed runtime plane (docs/DISTRIBUTED.md): the shared wire
+codec + shuffle message layer, the partition planner, the
+credit-backpressured shuffle transport (both channel planes), FaultPlan
+network actions, per-worker log naming, the merged one-graph view --
+and the real 2-process acceptance runs: bitwise-equal NexMark Q5,
+drop_link flagged with exact edge + count, a doctor verdict naming a
+remote worker's operator, and kill_worker + run-from-epoch recovery
+matching the uninterrupted oracle.
+
+NOTE this file doubles as the worker-side build module: the 2-process
+tests' build/config functions are imported by fresh worker interpreters
+(distributed/runtime._load_ref), so everything at module level must
+import cleanly without pytest fixtures, conftest, or JAX.
+"""
+import collections
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core.basic import Pattern, RoutingMode, RuntimeConfig
+from windflow_tpu.core.tuples import BasicRecord, TupleBatch
+from windflow_tpu.distributed import wire
+from windflow_tpu.distributed.partition import (PartitionError,
+                                                node_owner, plan_partition)
+from windflow_tpu.distributed.runtime import DistributedSpec
+from windflow_tpu.distributed.transport import (EdgeState,
+                                                RemoteEdgeSender,
+                                                ShuffleServer)
+from windflow_tpu.operators.base import Operator, StageSpec
+from windflow_tpu.resilience import FaultPlan
+from windflow_tpu.runtime.emitters import StandardEmitter
+from windflow_tpu.runtime.node import EOSMarker, SourceLoopLogic
+from windflow_tpu.runtime.queues import EpochBarrier, make_channel
+
+N_KEYS = 8
+
+
+def _batch(lo, n, keys=N_KEYS):
+    i = np.arange(lo, lo + n)
+    return TupleBatch({"key": i % keys, "id": i // keys, "ts": i,
+                       "value": (i % 13).astype(np.float64)})
+
+
+# ---------------------------------------------------------------------------
+# wire codec: shared framing + shuffle message layer
+# ---------------------------------------------------------------------------
+
+class TestWireCodec:
+    def test_legacy_import_path_still_works(self):
+        from windflow_tpu.ingest import codec as legacy
+        b = _batch(0, 100)
+        rt = legacy.decode_batch(legacy.encode_batch(b)[8:])
+        assert np.array_equal(rt.key, b.key)
+        # the shim exposes the whole promoted surface, with a warning
+        with pytest.warns(DeprecationWarning):
+            assert legacy.MsgDecoder is wire.MsgDecoder
+        # and the canonical home is the distributed plane
+        assert legacy.encode_batch is wire.encode_batch
+        assert legacy.StreamDecoder is wire.StreamDecoder
+
+    def test_msg_roundtrip_fuzzed_partial_frames(self):
+        msgs = []
+        for i in range(40):
+            kind, payload, _c = wire.encode_item(_batch(i * 50, 50))
+            msgs.append((kind, i % 3, i + 1, payload))
+        msgs.append((wire.MSG_EOS, 0, 41, b""))
+        blob = b"".join(wire.encode_msg(*m) for m in msgs)
+        rng = np.random.default_rng(7)
+        for _ in range(5):
+            dec = wire.MsgDecoder()
+            got = []
+            off = 0
+            while off < len(blob):
+                n = int(rng.integers(1, 97))
+                got.extend(dec.feed(blob[off:off + n]))
+                off += n
+            assert len(got) == len(msgs)
+            for (k, p, s, pl), (k2, p2, s2, pl2) in zip(msgs, got):
+                assert (k, p, s) == (k2, p2, s2) and pl == pl2
+            assert dec.pending_bytes() == 0
+
+    def test_stream_decoder_fuzzed_partials(self):
+        batches = [_batch(i * 100, 100) for i in range(10)]
+        blob = b"".join(wire.encode_batch(b) for b in batches)
+        rng = np.random.default_rng(3)
+        dec = wire.StreamDecoder()
+        got = []
+        off = 0
+        while off < len(blob):
+            n = int(rng.integers(1, 61))
+            got.extend(dec.feed(blob[off:off + n]))
+            off += n
+        assert len(got) == len(batches)
+        for a, b in zip(got, batches):
+            assert np.array_equal(a.key, b.key)
+            assert np.array_equal(a["value"], b["value"])
+
+    def test_zero_tuple_frame(self):
+        empty = TupleBatch({"key": np.array([], np.int64),
+                            "id": np.array([], np.int64),
+                            "ts": np.array([], np.int64),
+                            "value": np.array([], np.float64)})
+        rt = wire.decode_batch(wire.encode_batch(empty)[8:])
+        assert len(rt) == 0 and set(rt.cols) == set(empty.cols)
+        kind, payload, cost = wire.encode_item(empty)
+        assert kind == wire.MSG_DATA and cost == 1  # min credit charge
+        item, cost2 = wire.decode_item(kind, payload, "e")
+        assert len(item) == 0 and cost2 == 1
+
+    def test_oversized_frame_rejected(self):
+        big = wire.encode_msg(wire.MSG_RECORD, 0, 1, b"x" * 256)
+        dec = wire.MsgDecoder(max_frame_bytes=64)
+        with pytest.raises(ValueError, match="exceeds"):
+            dec.feed(big)
+        sd = wire.StreamDecoder(max_frame_bytes=64)
+        with pytest.raises(ValueError, match="exceeds"):
+            sd.feed(wire.encode_batch(_batch(0, 1000)))
+        with pytest.raises(ValueError, match="desync"):
+            wire.MsgDecoder().feed(b"JUNKJUNKJUNKJUNKJUNKJUNK")
+
+    def test_item_kinds_roundtrip(self):
+        rec = BasicRecord(3, 7, 11, 2.5)
+        for item, want_kind in (
+                (rec, wire.MSG_RECORD),
+                (EOSMarker(rec), wire.MSG_RECORD),
+                (EpochBarrier(9), wire.MSG_BARRIER),
+                (EpochBarrier(-1, final=True), wire.MSG_BARRIER)):
+            kind, payload, _c = wire.encode_item(item)
+            assert kind == want_kind
+            back, _c2 = wire.decode_item(kind, payload, "e")
+            if isinstance(item, EpochBarrier):
+                assert type(back) is EpochBarrier
+                assert (back.epoch, back.final) == (item.epoch, item.final)
+            elif isinstance(item, EOSMarker):
+                assert isinstance(back, EOSMarker)
+                assert back.record.key == rec.key
+            else:
+                assert (back.key, back.id, back.value) == (3, 7, 2.5)
+
+    def test_trace_rides_the_frame_as_wire_hop(self):
+        from windflow_tpu.telemetry.trace import TraceContext
+        b = _batch(0, 10)
+        t0 = time.perf_counter() - 0.050
+        ctx = TraceContext("pipe0/src", t0)
+        ctx.hop("pipe0/map", t0 + 0.010, t0 + 0.030)
+        b.trace = ctx
+        kind, payload, _c = wire.encode_item(b)
+        assert b.trace is ctx  # sender-side context untouched
+        item, _cost = wire.decode_item(kind, payload, "pipe0/agg.0")
+        rb = item.trace
+        assert rb is not None and rb.src == "pipe0/src"
+        names = [h[0] for h in rb.hops]
+        assert names == ["pipe0/map", "pipe0/agg.0@wire"]
+        # rebased offsets survive the boundary (~10ms hop arrival)
+        a = rb.hops[0][1] - rb.t0
+        assert 0.005 < a < 0.02
+        # attribution charges the crossing to the 'wire' class
+        from windflow_tpu.diagnosis.attribution import trace_breakdown
+        t_end = time.perf_counter()
+        bd = trace_breakdown(rb.to_dict(t_end))
+        assert bd is not None and bd["classes"]["wire"] > 0.0
+
+    def test_attribution_classes_sum_with_wire(self):
+        from windflow_tpu.diagnosis.attribution import trace_breakdown
+        rec = {"e2e_ms": 10.0,
+               "hops": [["src", 0.0, 1.0], ["agg.0@wire", 1.0, 5.0],
+                        ["agg.0", 6.0, 9.0]]}
+        bd = trace_breakdown(rec)
+        total = sum(bd["classes"].values())
+        assert abs(total - 10.0) < 1e-6
+        assert abs(bd["classes"]["wire"] - 4.0) < 1e-6
+        # the 5->6 gap before agg's arrival + the 9->10 trailing close
+        assert abs(bd["classes"]["queueing"] - 2.0) < 1e-6
+        assert abs(bd["classes"]["service"] - 4.0) < 1e-6
+
+
+# ---------------------------------------------------------------------------
+# partition planner
+# ---------------------------------------------------------------------------
+
+def _keyed_pipeline(g, acc_par=2):
+    out = []
+
+    def src(shipper):
+        return False
+
+    def fold(t, acc):
+        acc.value += t.value
+
+    g.add_source(wf.SourceBuilder(src).with_name("psrc").build()) \
+        .add(wf.AccumulatorBuilder(fold).with_name("pfold")
+             .with_parallelism(acc_par).build()) \
+        .add_sink(wf.SinkBuilder(out.append).with_name("psink").build())
+    return g
+
+
+class TestPartition:
+    def test_auto_cut_at_keyby_edge(self):
+        g = _keyed_pipeline(wf.PipeGraph("p"))
+        plan = plan_partition(g, 2)
+        assert plan["pipe0/psrc"] == 0
+        assert plan["pipe0/pfold.0"] == plan["pipe0/pfold.1"] \
+            == plan["pipe0/psink.0"] == 1
+
+    def test_single_worker_collapses(self):
+        g = _keyed_pipeline(wf.PipeGraph("p1"))
+        plan = plan_partition(g, 1)
+        assert set(plan.values()) == {0}
+
+    def test_forward_chain_stays_colocated(self):
+        g = wf.PipeGraph("pf")
+        g.add_source(wf.SourceBuilder(lambda s: False)
+                     .with_name("fsrc").build()) \
+            .add(wf.MapBuilder(lambda t: t).with_name("fmap").build()) \
+            .add_sink(wf.SinkBuilder(lambda r: None)
+                      .with_name("fsink").build())
+        plan = plan_partition(g, 2)
+        assert len(set(plan.values())) == 1  # no shuffle edge: no cut
+
+    def test_pins_cut_forward_edges(self):
+        g = wf.PipeGraph("pp")
+        g.add_source(wf.SourceBuilder(lambda s: False)
+                     .with_name("asrc").with_worker(0).build()) \
+            .add(wf.MapBuilder(lambda t: t).with_name("amap")
+                 .with_worker(1).build()) \
+            .add_sink(wf.SinkBuilder(lambda r: None)
+                      .with_name("asink").build())
+        plan = plan_partition(g, 2)
+        assert plan["pipe0/asrc"] == 0
+        assert plan["pipe0/amap.0"] == 1
+        assert plan["pipe0/asink.0"] == 1  # FORWARD glue follows the pin
+
+    def test_conflicting_pins_in_one_group_raise(self):
+        g = wf.PipeGraph("pc")
+        g.add_source(wf.SourceBuilder(lambda s: False)
+                     .with_name("csrc").build()) \
+            .add(wf.MapBuilder(lambda t: t).with_name("cmap").build()) \
+            .add_sink(wf.SinkBuilder(lambda r: None)
+                      .with_name("csink").build())
+        with pytest.raises(PartitionError, match="conflicting"):
+            plan_partition(g, 2, overrides={"csrc": 0, "csink": 1})
+
+    def test_override_assignment_beats_auto(self):
+        g = _keyed_pipeline(wf.PipeGraph("po"))
+        plan = plan_partition(g, 2, overrides={"pfold": 0, "psrc": 1})
+        assert plan["pipe0/psrc"] == 1
+        assert plan["pipe0/pfold.0"] == 0
+
+    def test_pin_survives_chaining(self):
+        g = wf.PipeGraph("pch")
+        g.add_source(wf.SourceBuilder(lambda s: False)
+                     .with_name("hsrc").build()) \
+            .add(wf.MapBuilder(lambda t: t).with_name("hmap").build()) \
+            .chain_sink(wf.SinkBuilder(lambda r: None)
+                        .with_name("hsink").with_worker(1).build())
+        # the sink fused into the map's node; its pin must pin the
+        # merged node (and, via FORWARD glue, the whole group)
+        plan = plan_partition(g, 2)
+        assert set(plan.values()) == {1}
+
+    def test_fusion_respects_partition(self):
+        from windflow_tpu.graph.fuse import fuse_graph
+        g = wf.PipeGraph("pfz")
+        g.add_source(wf.SourceBuilder(lambda s: False)
+                     .with_name("zsrc").with_worker(0).build()) \
+            .add(wf.MapBuilder(lambda t: t).with_name("zmap")
+                 .with_worker(1).build()) \
+            .add_sink(wf.SinkBuilder(lambda r: None)
+                      .with_name("zsink").with_worker(1).build())
+        plan = plan_partition(g, 2)
+        fuse_graph(g)
+        for n in g._all_nodes():
+            node_owner(n, plan)  # raises if a fused node straddles
+
+
+# ---------------------------------------------------------------------------
+# shuffle transport, in-process over loopback (both channel planes)
+# ---------------------------------------------------------------------------
+
+def _planes():
+    planes = ["python"]
+    from windflow_tpu.runtime.native import native_available
+    if native_available():
+        planes.append("native")
+    return planes
+
+
+def _channel_for(plane, capacity=2048):
+    cfg = RuntimeConfig(queue_capacity=capacity,
+                        use_native_runtime=(plane == "native"))
+    return make_channel(cfg)
+
+
+class _Rig:
+    """One in-process shuffle edge: consumer graph + server on worker 1,
+    producer graph + sender on worker 0."""
+
+    EDGE = "pipe0/rig_sink.0"
+
+    def __init__(self, plane, n_pids=2, capacity=2048, wire_credits=1 << 15,
+                 grace_s=0.5, faults=None):
+        self.chan = _channel_for(plane, capacity)
+        self.pids = [self.chan.register_producer() for _ in range(n_pids)]
+        self.cgraph = wf.PipeGraph("rig_consumer")
+        self.pgraph = wf.PipeGraph("rig_producer")
+        cspec = DistributedSpec(1, 2, [("127.0.0.1", 0), ("127.0.0.1", 0)],
+                                reconnect_grace_s=grace_s)
+        self.edge = EdgeState(self.EDGE, self.chan, {0: set(self.pids)})
+        self.server = ShuffleServer(self.cgraph, cspec,
+                                    {self.EDGE: self.edge})
+        self.server.start()
+        pspec = DistributedSpec(0, 2, [("127.0.0.1", 0),
+                                       ("127.0.0.1", self.server.port)],
+                                wire_credits=wire_credits)
+        self.sender = RemoteEdgeSender(self.EDGE, "127.0.0.1",
+                                       self.server.port, self.pgraph,
+                                       self.pids, pspec)
+        if faults is not None:
+            self.sender.faults = faults.for_link(self.EDGE)
+
+    def drain(self, timeout=10.0):
+        out = []
+        deadline = time.monotonic() + timeout
+        while True:
+            got = self.chan.get(timeout=0.2)
+            if got is None:
+                return out
+            if isinstance(got, tuple):
+                out.append(got)
+            if time.monotonic() > deadline:
+                raise AssertionError(f"drain timed out with {len(out)}")
+
+    def close(self):
+        self.server.stop()
+
+
+@pytest.mark.parametrize("plane", _planes())
+class TestTransport:
+    def test_roundtrip_data_records_eos(self, plane):
+        rig = _Rig(plane)
+        try:
+            for i in range(10):
+                rig.sender.put(rig.pids[i % 2], _batch(i * 64, 64))
+            rig.sender.put(rig.pids[0], BasicRecord(1, 2, 3, 4.0))
+            for pid in rig.pids:
+                rig.sender.close(pid)
+            got = rig.drain()
+            batches = [it for _pid, it in got
+                       if isinstance(it, TupleBatch)]
+            recs = [it for _pid, it in got
+                    if isinstance(it, BasicRecord)]
+            assert len(batches) == 10 and len(recs) == 1
+            assert sum(len(b) for b in batches) == 640
+            assert rig.sender.flush(5.0)      # every frame acked
+            assert rig.sender.tuples_sent == 641
+            assert rig.sender.gets == rig.sender.puts
+            assert rig.sender.qsize() == 0
+            # credits fully replenished once the consumer drained
+            deadline = time.monotonic() + 2.0
+            while rig.sender.gate.available < rig.sender.gate.budget:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            rows = rig.edge.blocks()
+            assert sum(r["tuples"] for r in rows) == 641
+            assert rig.edge.completed
+            assert not rig.cgraph._cancel.cancelled
+        finally:
+            rig.close()
+
+    def test_credit_backpressure_throttles_producer(self, plane):
+        rig = _Rig(plane, n_pids=1, capacity=4, wire_credits=8)
+        try:
+            sent = []
+
+            def producer():
+                for i in range(64):
+                    rig.sender.put(rig.pids[0], _batch(i, 1))
+                    sent.append(i)
+                rig.sender.close(rig.pids[0])
+
+            t = threading.Thread(target=producer, daemon=True)
+            t.start()
+            time.sleep(0.8)
+            # consumer never polled: the producer must be credit-stalled
+            # well short of the stream (window + channel bound)
+            assert len(sent) < 40
+            stalled_at = len(sent)
+            got = rig.drain()
+            t.join(timeout=5.0)
+            assert not t.is_alive()
+            assert len(got) == 64 > stalled_at
+            assert rig.sender.gate.credit_waits > 0
+        finally:
+            rig.close()
+
+    def test_reconnect_mid_stream_no_loss_no_dup(self, plane):
+        rig = _Rig(plane, n_pids=1)
+        try:
+            for i in range(10):
+                rig.sender.put(rig.pids[0], _batch(i * 10, 10))
+            assert rig.sender.flush(5.0)
+            # transport blip: kill the socket under the sender
+            sock = rig.sender._sock
+            assert sock is not None
+            sock.close()
+            for i in range(10, 20):
+                rig.sender.put(rig.pids[0], _batch(i * 10, 10))
+            rig.sender.close(rig.pids[0])
+            got = rig.drain()
+            ids = sorted(int(b.ts[0]) for _pid, b in got)
+            assert ids == [i * 10 for i in range(20)]  # exactly once
+            assert rig.sender.reconnects >= 1
+            assert not rig.cgraph._cancel.cancelled
+            assert rig.edge.completed
+        finally:
+            rig.close()
+
+    def test_broken_link_cancels_consumer_after_grace(self, plane):
+        rig = _Rig(plane, n_pids=1, grace_s=0.3)
+        try:
+            rig.sender.put(rig.pids[0], _batch(0, 5))
+            assert rig.sender.flush(5.0)
+            rig.sender._cancelled = True   # producer goes silent...
+            rig.sender._close_sock()       # ...and the socket dies
+            deadline = time.monotonic() + 5.0
+            while not rig.cgraph._cancel.cancelled:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            assert "rig_sink" in str(rig.cgraph._cancel.reason)
+        finally:
+            rig.close()
+
+    def test_drop_link_flags_edge_and_count(self, plane):
+        plan = FaultPlan().drop_link("rig_sink", at_frame=3)
+        rig = _Rig(plane, n_pids=1, faults=plan)
+        try:
+            for i in range(6):
+                rig.sender.put(rig.pids[0], _batch(i * 10, 10))
+            rig.sender.close(rig.pids[0])
+            got = rig.drain()
+            assert len(got) == 5          # frame 3 lost on the wire
+            assert rig.sender.frames_dropped == 1
+            assert rig.sender.tuples_sent == 60
+            rows = rig.edge.blocks()
+            assert rows[0]["tuples"] == 50 and rows[0]["gaps"] == 1
+            # the consumer flags the loss online with edge + count
+            events = rig.cgraph.flight.snapshot()
+            assert any(e.get("kind") == "wire_gap"
+                       and e.get("edge") == "pipe0/rig_sink.0"
+                       for e in events)
+            assert any(e.get("kind") == "conservation_violation"
+                       and e.get("edge") == "pipe0/rig_sink.0"
+                       and e.get("count") == 10
+                       for e in events)
+        finally:
+            rig.close()
+
+    def test_delay_link_applies(self, plane):
+        plan = FaultPlan().delay_link("rig_sink", delay_ms=40, every_n=2)
+        rig = _Rig(plane, n_pids=1, faults=plan)
+        try:
+            t0 = time.monotonic()
+            for i in range(6):
+                rig.sender.put(rig.pids[0], _batch(i, 4))
+            elapsed = time.monotonic() - t0
+            assert elapsed >= 0.10        # 3 delayed frames x 40ms
+            rig.sender.close(rig.pids[0])
+            assert len(rig.drain()) == 6
+        finally:
+            rig.close()
+
+    def test_barriers_ride_frames(self, plane):
+        rig = _Rig(plane, n_pids=2)
+        try:
+            rig.sender.put(rig.pids[0], _batch(0, 8))
+            rig.sender.put(rig.pids[0], EpochBarrier(1))
+            rig.sender.put(rig.pids[1], EpochBarrier(1))
+            for pid in rig.pids:
+                rig.sender.close(pid)
+            got = rig.drain()
+            barriers = [(pid, it) for pid, it in got
+                        if type(it) is EpochBarrier]
+            assert len(barriers) == 2
+            assert {pid for pid, _ in barriers} == set(rig.pids)
+            assert all(b.epoch == 1 for _pid, b in barriers)
+            assert rig.sender.barriers_sent == 2
+            rows = rig.edge.blocks()
+            assert sum(r["barriers"] for r in rows) == 2
+        finally:
+            rig.close()
+
+
+# ---------------------------------------------------------------------------
+# per-worker log/snapshot naming + merged view
+# ---------------------------------------------------------------------------
+
+class TestWorkerArtifacts:
+    def test_worker_suffix_in_flight_dump(self, tmp_path, monkeypatch):
+        from windflow_tpu.telemetry.recorder import FlightRecorder
+        monkeypatch.setenv("WINDFLOW_WORKER_ID", "3")
+        fr = FlightRecorder(8)
+        fr.record("x", a=1)
+        path = fr.dump(str(tmp_path), "gname")
+        assert path.endswith(f"{os.getpid()}_gname_w3_flight.jsonl")
+        monkeypatch.delenv("WINDFLOW_WORKER_ID")
+        path2 = fr.dump(str(tmp_path), "gname")
+        assert path2.endswith(f"{os.getpid()}_gname_flight.jsonl")
+
+    def test_worker_identity_helpers(self, monkeypatch):
+        from windflow_tpu.distributed.identity import (worker_id,
+                                                       worker_suffix)
+        monkeypatch.delenv("WINDFLOW_WORKER_ID", raising=False)
+        assert worker_id() is None and worker_suffix() == ""
+        monkeypatch.setenv("WINDFLOW_WORKER_ID", "7")
+        assert worker_id() == 7 and worker_suffix() == "_w7"
+        monkeypatch.setenv("WINDFLOW_WORKER_ID", "junk")
+        assert worker_id() is None
+
+    def test_merge_stats_flags_wire_imbalance(self):
+        from windflow_tpu.distributed.observe import (
+            check_wire_conservation, merge_stats)
+        w0 = {"PipeGraph_name": "g", "Worker": 0, "Schema_version": 5,
+              "Operators": [{"Operator_name": "pipe0/src",
+                             "Replicas": []}],
+              "Wire": {"Worker": 0, "in": [], "out": [
+                  {"edge": "pipe0/agg.0", "tuples": 100, "frames": 12,
+                   "barriers": 0, "dropped_frames": 1}]}}
+        w1 = {"PipeGraph_name": "g", "Worker": 1, "Schema_version": 5,
+              "Operators": [{"Operator_name": "pipe0/agg",
+                             "Replicas": []}],
+              "Conservation": {"Edges_balanced": True,
+                               "Final_check": True},
+              "Wire": {"Worker": 1, "out": [], "in": [
+                  {"edge": "pipe0/agg.0", "from_worker": 0,
+                   "tuples": 90, "frames": 11, "barriers": 0,
+                   "gaps": 1}]}}
+        merged = merge_stats([w0, w1])
+        assert merged["Operator_number"] == 2
+        assert {op["Worker"] for op in merged["Operators"]} == {0, 1}
+        wire_block = merged["Wire"]
+        assert not wire_block["Balanced"]
+        row = wire_block["Edges"][0]
+        assert row["edge"] == "pipe0/agg.0"
+        assert row["missing_tuples"] == 10
+        v = merged["Conservation"]["Violations"]
+        assert any(x["kind"] == "lost_wire_delivery"
+                   and x["edge"] == "pipe0/agg.0" and x["count"] == 10
+                   for x in v)
+        assert check_wire_conservation([w0, w1]) \
+            == [{"kind": "lost_wire_delivery", "edge": "pipe0/agg.0",
+                 "count": 10}]
+
+
+# ---------------------------------------------------------------------------
+# 2-process runs (real worker processes over localhost)
+# ---------------------------------------------------------------------------
+
+def _dist_records(n):
+    for i in range(n):
+        yield i % N_KEYS, i // N_KEYS, i, float(i % 13)
+
+
+def _acc_oracle(n):
+    out = collections.defaultdict(list)
+    sums = collections.defaultdict(float)
+    for k, tid, _ts, v in _dist_records(n):
+        sums[k] += v
+        out[k].append((tid, sums[k]))
+    return dict(out)
+
+
+def _keyed_build(g, sink_fn, pace_every=0, pace_s=0.0,
+                 fold_name="dist_fold"):
+    """source -> KEYBY rolling fold (2 replicas) -> sink."""
+    import windflow_tpu as _wf
+    from windflow_tpu.core.tuples import BasicRecord as _Rec
+    n = int(os.environ["WFT_DIST_N"])
+    it = iter(enumerate(_dist_records(n)))
+
+    def src(shipper):
+        for i, (k, tid, ts, v) in it:
+            if pace_every and i % pace_every == 0:
+                time.sleep(pace_s)
+            shipper.push(_Rec(k, tid, ts, v))
+            return True
+        return False
+
+    def fold(t, acc):
+        acc.value += t.value
+
+    g.add_source(_wf.SourceBuilder(src).with_name("dist_src").build()) \
+        .add(_wf.AccumulatorBuilder(fold).with_name(fold_name)
+             .with_parallelism(2).build()) \
+        .add_sink(sink_fn)
+    return g
+
+
+def _rows_sink(out_path):
+    import windflow_tpu as _wf
+    rows = []
+
+    def sink(rec):
+        if rec is None:
+            with open(out_path, "w") as f:
+                json.dump(sorted(rows), f)
+        else:
+            rows.append([rec.key, rec.id, rec.value])
+
+    return _wf.SinkBuilder(sink).with_name("dist_sink").build()
+
+
+# -- worker-side build/config functions (imported by worker processes) --
+
+def build_basic(g):
+    _keyed_build(g, _rows_sink(os.environ["WFT_DIST_OUT"]))
+
+
+def config_counters(worker_id):
+    # stats records without per-item trace stamping: the merged view
+    # needs Operators rows, not sampled traces
+    return RuntimeConfig(tracing=True, trace_sample=0,
+                         log_dir=os.environ.get("WFT_LOG_DIR", "log"))
+
+
+def config_drop_link(worker_id):
+    plan = FaultPlan().drop_link("dist_fold", at_frame=5)
+    return RuntimeConfig(fault_plan=plan,
+                         log_dir=os.environ.get("WFT_LOG_DIR", "log"))
+
+
+def build_slow_remote(g):
+    import windflow_tpu as _wf
+    out_path = os.environ["WFT_DIST_OUT"]
+    n = int(os.environ["WFT_DIST_N"])
+    it = iter(range(n))
+
+    def src(shipper):
+        for i in it:
+            shipper.push(BasicRecord(i % N_KEYS, i // N_KEYS, i,
+                                     float(i % 13)))
+            return True
+        return False
+
+    def slow(t):
+        time.sleep(0.001)
+        return t
+
+    done = []
+
+    def sink(rec):
+        if rec is None:
+            with open(out_path, "w") as f:
+                json.dump({"count": len(done)}, f)
+        else:
+            done.append(1)
+
+    g.add_source(_wf.SourceBuilder(src).with_name("fast_src").build()) \
+        .add(_wf.MapBuilder(slow).with_name("slow_remote")
+             .with_key_by().build()) \
+        .add_sink(_wf.SinkBuilder(sink).with_name("obs_sink").build())
+
+
+def config_traced(worker_id):
+    return RuntimeConfig(tracing=True, trace_sample=32,
+                         log_dir=os.environ.get("WFT_LOG_DIR", "log"))
+
+
+class FileEpochWriter:
+    """File-backed idempotent sink target (``write(epoch, item)``):
+    every effect appends as a JSONL row tagged (attempt, epoch); a
+    restarted attempt first appends a truncation marker carrying its
+    restore epoch, and :func:`resolve_epoch_file` replays markers in
+    order -- exactly ``EpochTaggedStore.truncate_above`` applied at
+    read time, which is what makes effects durable across worker
+    processes."""
+
+    def __init__(self, path=None):
+        self.path = path or os.environ["WFT_DIST_OUT"]
+        self.attempt = int(os.environ.get("WINDFLOW_DIST_ATTEMPT", "0"))
+        restore = int(os.environ.get("WINDFLOW_DIST_RESTORE", "0"))
+        with open(self.path, "a") as f:
+            f.write(json.dumps({"marker": True, "a": self.attempt,
+                                "truncate_above": restore}) + "\n")
+
+    def write(self, epoch, item):
+        with open(self.path, "a") as f:
+            f.write(json.dumps({"a": self.attempt, "e": epoch,
+                                "k": item.key, "t": item.id,
+                                "v": item.value}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+
+def resolve_epoch_file(path):
+    """Fold the JSONL effect log: each attempt's truncation marker
+    drops earlier attempts' rows above its restore epoch (the
+    uncommitted tail a crashed attempt applied)."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            doc = json.loads(line)
+            if doc.get("marker"):
+                rows = [r for r in rows
+                        if r["e"] <= doc["truncate_above"]]
+            else:
+                rows.append(doc)
+    return rows
+
+
+class _DistCkptSourceLogic(SourceLoopLogic):
+    def __init__(self, n, pace_every, pace_s):
+        self.i = 0
+        self.n = n
+        self.pace_every = pace_every
+        self.pace_s = pace_s
+        super().__init__(self._step)
+
+    def _step(self, emit):
+        i = self.i
+        if i >= self.n:
+            return False
+        if self.pace_every and i % self.pace_every == 0:
+            time.sleep(self.pace_s)
+        emit(BasicRecord(i % N_KEYS, i // N_KEYS, i, float(i % 13)))
+        self.i = i + 1
+        return True
+
+    def state_dict(self):
+        return {"i": self.i}
+
+    def load_state(self, st):
+        self.i = st["i"]
+
+    def progress_frontier(self):
+        return self.i
+
+
+class DistCkptSource(Operator):
+    def __init__(self, n, pace_every=8, pace_s=0.003):
+        super().__init__("dur_src", 1, RoutingMode.NONE, Pattern.SOURCE)
+        self.n = n
+        self.pace_every = pace_every
+        self.pace_s = pace_s
+
+    def stages(self):
+        logic = _DistCkptSourceLogic(self.n, self.pace_every, self.pace_s)
+        return [StageSpec(self.name, [logic], StandardEmitter(),
+                          self.routing)]
+
+
+def build_durable(g):
+    import windflow_tpu as _wf
+    n = int(os.environ["WFT_DIST_N"])
+
+    def fold(t, acc):
+        acc.value += t.value
+
+    g.add_source(DistCkptSource(n)) \
+        .add(_wf.AccumulatorBuilder(fold).with_name("dur_fold")
+             .with_parallelism(2).build()) \
+        .add_sink(_wf.SinkBuilder(FileEpochWriter())
+                  .with_exactly_once("idempotent")
+                  .with_name("dur_sink").build())
+
+
+def config_durable(worker_id):
+    from windflow_tpu.core import DurabilityConfig
+    plan = FaultPlan()
+    kill_at = int(os.environ.get("WFT_KILL_AT", "0"))
+    if kill_at:
+        plan.kill_worker(0, at_tuple=kill_at)
+    return RuntimeConfig(
+        durability=DurabilityConfig(
+            epoch_interval_s=0.05,
+            path=os.environ["WFT_EPOCH_DIR"], retained=64),
+        fault_plan=plan,
+        log_dir=os.environ.get("WFT_LOG_DIR", "log"))
+
+
+def build_q5(g):
+    from windflow_tpu.models.nexmark import build_q5_hot_items
+    out_path = os.environ["WFT_Q5_OUT"]
+    n = int(os.environ["WFT_Q5_N"])
+    rows = []
+
+    def sink(item):
+        if item is None:
+            with open(out_path, "w") as f:
+                json.dump(sorted(rows), f)
+            return
+        if isinstance(item, TupleBatch):
+            for j in range(len(item)):
+                rows.append([int(item.key[j]), int(item.id[j]),
+                             float(item["value"][j])])
+        else:
+            rows.append([int(item.key), int(item.id),
+                         float(item.value)])
+
+    build_q5_hot_items(g, n, 8192, 4096, sink, n_auctions=40,
+                       batch_size=16_384, device_batch=512,
+                       parallelism=2, placement="host")
+
+
+def config_q5(worker_id):
+    return RuntimeConfig(log_dir=os.environ.get("WFT_LOG_DIR", "log"))
+
+
+@pytest.fixture()
+def dist_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("WFT_LOG_DIR", str(tmp_path / "log"))
+    return tmp_path
+
+
+class TestTwoProcess:
+    def test_smoke_bitwise_and_balanced(self, dist_env):
+        from windflow_tpu.distributed import smoke
+        assert smoke.main(["6000"]) == 0
+
+    def test_keyed_run_matches_local_and_ledger_closes(self, dist_env,
+                                                       monkeypatch):
+        from windflow_tpu.distributed.runtime import run_distributed
+        n = 4000
+        out = dist_env / "rows.json"
+        monkeypatch.setenv("WFT_DIST_N", str(n))
+        monkeypatch.setenv("WFT_DIST_OUT", str(out))
+        report = run_distributed(
+            build_basic, n_workers=2, config_fn=config_counters,
+            graph_name="tp_basic",
+            workdir=str(dist_env / "work"), timeout_s=120.0)
+        got = json.loads(out.read_text())
+        per_key = collections.defaultdict(list)
+        for k, tid, v in got:
+            per_key[k].append((tid, v))
+        assert {k: sorted(vs) for k, vs in per_key.items()} \
+            == {k: v for k, v in _acc_oracle(n).items()}
+        merged = report["merged"]
+        assert merged["Wire"]["Balanced"]
+        assert merged["Conservation"]["Edges_balanced"]
+        assert merged["Conservation"]["Final_check"]
+        # one logical graph, two workers, disjoint operator sets
+        assert {op["Worker"] for op in merged["Operators"]} == {0, 1}
+
+    def test_drop_link_flagged_with_exact_edge_and_count(self, dist_env,
+                                                         monkeypatch):
+        from windflow_tpu.distributed.runtime import run_distributed
+        n = 2000
+        out = dist_env / "rows.json"
+        monkeypatch.setenv("WFT_DIST_N", str(n))
+        monkeypatch.setenv("WFT_DIST_OUT", str(out))
+        report = run_distributed(
+            build_basic, n_workers=2, config_fn=config_drop_link,
+            graph_name="tp_drop", workdir=str(dist_env / "work"),
+            timeout_s=120.0)
+        merged = report["merged"]
+        assert not merged["Wire"]["Balanced"]
+        bad = [r for r in merged["Wire"]["Edges"] if not r["balanced"]]
+        # frame 5 of EACH fold replica's edge was a 1-record DATA frame
+        assert sorted(r["edge"] for r in bad) \
+            == ["pipe0/dist_fold.0", "pipe0/dist_fold.1"]
+        assert all(r["missing_tuples"] == 1 for r in bad)
+        assert all(r["dropped_frames"] == 1 for r in bad)
+        # ...and the consumer worker flagged it ONLINE, per edge
+        v = merged["Conservation"]["Violations"]
+        for edge in ("pipe0/dist_fold.0", "pipe0/dist_fold.1"):
+            assert any(x["kind"] == "lost_wire_delivery"
+                       and x["edge"] == edge and x["count"] == 1
+                       for x in v)
+        got = json.loads(out.read_text())
+        assert len(got) == n - 2          # exactly the dropped tuples
+
+    def test_doctor_names_remote_bottleneck(self, dist_env, monkeypatch):
+        from windflow_tpu.diagnosis.report import build_report
+        from windflow_tpu.distributed.runtime import run_distributed
+        n = 2600
+        out = dist_env / "obs.json"
+        monkeypatch.setenv("WFT_DIST_N", str(n))
+        monkeypatch.setenv("WFT_DIST_OUT", str(out))
+        report = run_distributed(
+            build_slow_remote, n_workers=2, config_fn=config_traced,
+            graph_name="tp_doctor", workdir=str(dist_env / "work"),
+            timeout_s=180.0)
+        merged = report["merged"]
+        # the slow operator lives on the REMOTE worker (not the source's)
+        by_name = {op["Operator_name"]: op for op in merged["Operators"]}
+        assert by_name["pipe0/slow_remote"]["Worker"] == 1
+        assert by_name["pipe0/fast_src"]["Worker"] == 0
+        rep = build_report(merged)
+        assert rep["Bottleneck"]["Operator"] == "pipe0/slow_remote"
+        assert rep["Bottleneck"]["Verdict"] in ("backpressure",
+                                                "mild_pressure",
+                                                "service_bound")
+        # the doctor CLI folds the same per-worker dumps with --merge
+        from windflow_tpu.doctor import main as doctor_main
+        rc = doctor_main([*report["stats_paths"], "--merge"])
+        assert rc == 0
+
+    def test_kill_worker_epoch_restart_matches_oracle(self, dist_env,
+                                                      monkeypatch):
+        from windflow_tpu.distributed.runtime import run_distributed
+        from windflow_tpu.distributed.wiring import KILL_EXIT
+        n = 4000
+        out = dist_env / "effects.jsonl"
+        monkeypatch.setenv("WFT_DIST_N", str(n))
+        monkeypatch.setenv("WFT_DIST_OUT", str(out))
+        monkeypatch.setenv("WFT_EPOCH_DIR", str(dist_env / "epochs"))
+        monkeypatch.setenv("WFT_KILL_AT", "2000")
+        report = run_distributed(
+            build_durable, n_workers=2, config_fn=config_durable,
+            graph_name="tp_kill", workdir=str(dist_env / "work"),
+            max_restarts=2, timeout_s=240.0)
+        assert report["attempts"] >= 2
+        assert report["exit_codes"][0][0] == KILL_EXIT  # the kill fired
+        # the restarted fleet resumed from a committed epoch, not zero
+        restores = [e for e in report["merged"].get("Flight") or []
+                    if e.get("kind") == "epoch_restore"]
+        assert restores and all(e["epoch"] >= 1 for e in restores)
+        rows = resolve_epoch_file(out)
+        per_key = collections.defaultdict(list)
+        for r in rows:
+            per_key[r["k"]].append((r["t"], r["v"]))
+        oracle = _acc_oracle(n)
+        assert {k: sorted(set(vs)) for k, vs in per_key.items()} \
+            == {k: v for k, v in oracle.items()}
+        # exactly-once: no duplicates survive the restart either
+        for k, vs in per_key.items():
+            assert len(vs) == len(set(vs)) == len(oracle[k])
+        assert report["merged"]["Wire"]["Balanced"]
+
+    def test_nexmark_q5_bitwise_equal_two_process(self, dist_env,
+                                                  monkeypatch):
+        from windflow_tpu.distributed.runtime import run_distributed
+        n = 60_000
+        monkeypatch.setenv("WFT_Q5_N", str(n))
+        # oracle: the SAME build, single process, in this interpreter
+        local_out = dist_env / "q5_local.json"
+        monkeypatch.setenv("WFT_Q5_OUT", str(local_out))
+        g = wf.PipeGraph("q5_local",
+                         config=config_q5(0))
+        build_q5(g)
+        g.run()
+        dist_out = dist_env / "q5_dist.json"
+        monkeypatch.setenv("WFT_Q5_OUT", str(dist_out))
+        report = run_distributed(
+            build_q5, n_workers=2, config_fn=config_q5,
+            graph_name="tp_q5", workdir=str(dist_env / "work"),
+            timeout_s=240.0)
+        # bitwise equality of the serialized result sets
+        assert dist_out.read_bytes() == local_out.read_bytes()
+        merged = report["merged"]
+        assert merged["Wire"]["Balanced"]
+        assert merged["Conservation"]["Edges_balanced"]
+        assert merged["Conservation"]["Final_check"]
+        # KeyFarmTPU coalesces to one engine replica (farms_tpu), so
+        # Q5's shuffle is one wire edge carrying every bid; the
+        # 2-replica-edge case is covered by the keyed-run test above
+        wire_edges = merged["Wire"]["Edges"]
+        assert len(wire_edges) >= 1
+        assert sum(r["tuples_sent"] for r in wire_edges) >= n

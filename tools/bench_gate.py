@@ -141,6 +141,18 @@ def measure() -> tuple:
     r2i, _lats, _evs, (sunk, sent) = bench.run_elastic_step(3_000)
     assert sunk == sent, f"elastic step lost tuples: {sunk}/{sent}"
     out["2i_elastic_step"] = round(r2i, 1)
+    # distributed-shuffle smoke (distributed/; docs/DISTRIBUTED.md):
+    # a real 2-process run over the credit-backpressured wire; the
+    # helper itself asserts end-to-end conservation (per-worker
+    # ledgers + the cross-process wire identity).  The rate includes
+    # worker spawn, so the tiny-N number mostly gates the transport
+    # not stalling -- a cliff here is a serialized/credit-wedged wire.
+    r12_2p, _r12_1p, cons12, d12 = bench.run_distributed_shuffle(N_NEX)
+    assert cons12, "distributed shuffle failed conservation"
+    out["12_distributed_shuffle"] = round(r12_2p, 1)
+    lats["12_distributed_shuffle"] = (
+        {"p50_ms": d12["latency_p50_ms"], "p99_ms": d12["latency_p99_ms"]}
+        if d12.get("latency_p99_ms") is not None else None)
     return out, {k: v for k, v in lats.items() if v}
 
 

@@ -69,6 +69,15 @@ def __getattr__(name):
         "RescaleEvent": "windflow_tpu.elastic",
         "RescaleError": "windflow_tpu.elastic",
         "LoadReport": "windflow_tpu.elastic",
+        # distributed runtime plane (distributed/; docs/DISTRIBUTED.md)
+        "DistributedSpec": "windflow_tpu.distributed",
+        "run_distributed": "windflow_tpu.distributed",
+        "WorkerFailure": "windflow_tpu.distributed",
+        "plan_partition": "windflow_tpu.distributed",
+        "merge_stats": "windflow_tpu.distributed",
+        "wire_table": "windflow_tpu.distributed",
+        "check_wire_conservation": "windflow_tpu.distributed",
+        "MsgDecoder": "windflow_tpu.distributed",
         # durability plane (durability/; docs/RESILIENCE.md
         # "Exactly-once epochs")
         "EpochCoordinator": "windflow_tpu.durability",

@@ -180,6 +180,11 @@ class FlowLedger:
     def _edge_name(self, edge: _Edge) -> str:
         if edge.consumer is not None:
             return edge.consumer.name
+        # distributed plane: a wire sender names its edge after the
+        # remote consumer it feeds (distributed/transport.py)
+        name = getattr(edge.channel, "edge_name", None)
+        if name is not None:
+            return name
         return f"channel@{edge.key:x}"
 
     def _report(self, key: tuple, count: int, make) -> Optional[dict]:

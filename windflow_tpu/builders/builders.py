@@ -35,6 +35,9 @@ def _alias_camel(cls):
             policy = getattr(self, "error_policy", "fail")
             if policy != "fail":
                 op.error_policy = policy
+            pin = getattr(self, "worker_pin", None)
+            if pin is not None:
+                op.worker = pin
             spec = getattr(self, "elasticity", None)
             if spec is not None:
                 if op.parallelism > spec.max_replicas:
@@ -76,6 +79,7 @@ class _BuilderBase:
         self.closing_func = None
         self.error_policy = "fail"
         self.elasticity = None
+        self.worker_pin = None
 
     def with_name(self, name: str):
         self.name = name
@@ -98,6 +102,19 @@ class _BuilderBase:
         docs/RESILIENCE.md."""
         from ..resilience.policies import validate_policy
         self.error_policy = validate_policy(policy)
+        return self
+
+    def with_worker(self, worker: int):
+        """Pin this operator to worker ``worker`` of a distributed run
+        (docs/DISTRIBUTED.md): the partition planner places its whole
+        co-located group there, and an edge between two differently-
+        pinned operators becomes a cut (carried by the shuffle
+        transport) even when it is a FORWARD edge.  Ignored outside
+        ``RuntimeConfig.distributed`` runs."""
+        worker = int(worker)
+        if worker < 0:
+            raise ValueError("with_worker: worker ids are >= 0")
+        self.worker_pin = worker
         return self
 
     def with_elasticity(self, min_replicas: int, max_replicas: int,

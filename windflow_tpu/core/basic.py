@@ -316,3 +316,10 @@ class RuntimeConfig:
     # idempotent sink contract (SinkBuilder.with_exactly_once).  None
     # (the default) keeps the pre-durability hot path untouched.
     durability: Any = None
+    # -- distributed runtime plane (distributed/; docs/DISTRIBUTED.md) --
+    # distributed.DistributedSpec partitioning this graph across worker
+    # processes: PipeGraph.start prunes to the worker's own partition
+    # and carries every cross-worker edge over the credit-backpressured
+    # shuffle transport.  None (the default) = single-process graph;
+    # normally set by the worker entry point, not by hand.
+    distributed: Any = None

@@ -39,9 +39,13 @@ from typing import Dict, List, Optional
 from ..audit.ledger import _op_of
 
 # hop classes, in display order
-CLASSES = ("service", "queueing", "device_transport", "device_compute")
+CLASSES = ("service", "queueing", "wire", "device_transport",
+           "device_compute")
 # suffix the device engines stamp on their dispatcher hops
 DEVICE_HOP_SUFFIX = "@device"
+# suffix the shuffle transport stamps on cross-worker crossings
+# (distributed/wire.rebuild_trace): the whole hop is wire residency
+WIRE_HOP_SUFFIX = "@wire"
 # per-trace breakdowns kept for aggregation
 MAX_TRACES = 256
 # operator rows kept in the breakdown table
@@ -61,21 +65,28 @@ def trace_breakdown(rec: dict,
         return None
     if e2e <= 0.0:
         return None
-    ivs = []  # (arrive, done, operator, is_device)
+    ivs = []  # (arrive, done, operator, kind: ""|"device"|"wire")
     for hop in raw_hops:
         try:
             name, a, d = hop[0], float(hop[1]), float(hop[2])
         except (TypeError, ValueError, IndexError):
             continue
-        device = str(name).endswith(DEVICE_HOP_SUFFIX)
-        op = _op_of(str(name)[:-len(DEVICE_HOP_SUFFIX)] if device
-                    else str(name))
+        name = str(name)
+        if name.endswith(DEVICE_HOP_SUFFIX):
+            kind = "device"
+            op = _op_of(name[:-len(DEVICE_HOP_SUFFIX)])
+        elif name.endswith(WIRE_HOP_SUFFIX):
+            kind = "wire"
+            op = _op_of(name[:-len(WIRE_HOP_SUFFIX)])
+        else:
+            kind = ""
+            op = _op_of(name)
         # clamp into the traced span: fused upstream segments stamp
         # their hops moments AFTER the sink closes (entries unwind
         # outward), so done can exceed e2e by scheduler noise
         a = min(max(0.0, a), e2e)
         d = min(max(a, d), e2e)
-        ivs.append((a, d, op, device))
+        ivs.append((a, d, op, kind))
     per_class: Dict[str, float] = dict.fromkeys(CLASSES, 0.0)
     per_op: Dict[str, Dict[str, float]] = {}
 
@@ -96,14 +107,17 @@ def trace_breakdown(rec: dict,
             continue
         covering = [iv for iv in ivs if iv[0] <= t1 and iv[1] >= t2]
         if covering:
-            # innermost: latest arrival (device hop wins a tie -- it is
-            # the more specific statement about where the time went)
-            a, d, op, device = max(covering, key=lambda iv: (iv[0], iv[3]))
-            if device:
+            # innermost: latest arrival (a device/wire hop wins a tie
+            # -- it is the more specific statement about the time)
+            a, d, op, kind = max(covering,
+                                 key=lambda iv: (iv[0], bool(iv[3])))
+            if kind == "device":
                 hop_ms = max(d - a, 1e-9)
                 tfrac = min(1.0, (rtt_floor_ms or 0.0) / hop_ms)
                 charge(op, "device_transport", dur * tfrac)
                 charge(op, "device_compute", dur * (1.0 - tfrac))
+            elif kind == "wire":
+                charge(op, "wire", dur)
             else:
                 charge(op, "service", dur)
         else:

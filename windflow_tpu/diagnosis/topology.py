@@ -56,6 +56,13 @@ def operator_edges(graph) -> List[List[str]]:
                 if c is None or c is n:
                     continue
                 add(chain[-1], _op_chain(c)[0], "channel")
+    # distributed plane (distributed/wiring.py): cross-worker edges --
+    # the consumer lives in another process, so the channel walk above
+    # cannot see it; the wiring recorded the operator pair instead.
+    # Kind "wire": no local queue, pressure propagates through the
+    # credit window.
+    for a, b, kind in getattr(graph, "_wire_topology", ()):
+        add(a, b, kind)
     return edges
 
 

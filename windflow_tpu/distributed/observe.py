@@ -1,0 +1,212 @@
+"""Cross-worker observability: merge per-worker stats JSON dumps into
+one graph view (docs/DISTRIBUTED.md "One graph view").
+
+Each worker of a distributed run reports exactly like a single-process
+graph -- same stats JSON, same Conservation/Diagnosis/Wire blocks,
+plus a ``Worker`` id -- and this module folds N such dumps into the
+ONE report the operator actually wants:
+
+* **operators** concatenate (every operator lives on exactly one
+  worker; its rows carry the worker id);
+* **topology** edges union, including the ``wire`` edges each
+  producer-side worker recorded, so the bottleneck walk crosses the
+  process boundary and can name an operator on a REMOTE worker;
+* the **cross-process conservation identity**: every wire edge's
+  producer-side book (tuples/frames sent) must equal the consumer-side
+  book (delivered) -- with per-worker ledgers already balanced
+  per-edge, the composition proves end-to-end transport conservation;
+  any shortfall is reported with the exact edge and tuple count;
+* trace records concatenate, so the merged attribution charges the
+  ``wire`` hop class alongside service/queueing/device.
+
+``build_report`` (diagnosis/report.py) accepts the merged dict as-is:
+the per-worker ``Diagnosis`` blocks are folded into their recompute
+inputs (sustained-depth union), so the bottleneck/attribution are
+re-derived over the whole graph rather than per partition.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+MAX_TRACES = 128
+MAX_FLIGHT = 256
+MAX_EDGE_ROWS = 128
+
+
+def wire_table(stats_list: List[dict]) -> List[dict]:
+    """Per-wire-edge cross-process delivery books: producer-side sums
+    vs consumer-side sums."""
+    sent: Dict[str, dict] = {}
+    got: Dict[str, dict] = {}
+    for stats in stats_list:
+        wire = (stats or {}).get("Wire") or {}
+        w = wire.get("Worker")
+        for row in wire.get("out") or ():
+            agg = sent.setdefault(row["edge"], {
+                "tuples": 0, "frames": 0, "barriers": 0,
+                "dropped_frames": 0, "from": []})
+            agg["tuples"] += int(row.get("tuples", 0) or 0)
+            agg["frames"] += int(row.get("frames", 0) or 0)
+            agg["barriers"] += int(row.get("barriers", 0) or 0)
+            agg["dropped_frames"] += int(row.get("dropped_frames", 0)
+                                         or 0)
+            agg["from"].append(w)
+        for row in wire.get("in") or ():
+            agg = got.setdefault(row["edge"], {
+                "tuples": 0, "frames": 0, "barriers": 0, "gaps": 0,
+                "on": w})
+            agg["tuples"] += int(row.get("tuples", 0) or 0)
+            agg["frames"] += int(row.get("frames", 0) or 0)
+            agg["barriers"] += int(row.get("barriers", 0) or 0)
+            agg["gaps"] += int(row.get("gaps", 0) or 0)
+    rows = []
+    for edge in sorted(set(sent) | set(got)):
+        s = sent.get(edge) or {}
+        g = got.get(edge) or {}
+        st, gt = int(s.get("tuples", 0)), int(g.get("tuples", 0))
+        rows.append({
+            "edge": edge,
+            "from_workers": sorted(x for x in s.get("from", [])
+                                   if x is not None),
+            "on_worker": g.get("on"),
+            "tuples_sent": st, "tuples_delivered": gt,
+            "frames_sent": int(s.get("frames", 0)),
+            "frames_delivered": int(g.get("frames", 0)),
+            "barriers_sent": int(s.get("barriers", 0)),
+            "barriers_delivered": int(g.get("barriers", 0)),
+            "dropped_frames": int(s.get("dropped_frames", 0)),
+            "gaps": int(g.get("gaps", 0)),
+            "missing_tuples": max(0, st - gt),
+            "balanced": st == gt,
+        })
+    return rows
+
+
+def merge_stats(stats_list: List[dict]) -> dict:
+    """Fold per-worker stats dicts into one graph view (see module
+    docstring).  Tolerant: blocks are optional per worker, like every
+    stats-JSON reader in the repo."""
+    stats_list = [s for s in stats_list if isinstance(s, dict)]
+    if not stats_list:
+        return {}
+    first = stats_list[0]
+    operators: List[dict] = []
+    edges_seen = set()
+    topology: List[List[str]] = []
+    traces: List[dict] = []
+    flight: List[dict] = []
+    cons_rows: List[dict] = []
+    violations: List[dict] = []
+    sustained: Dict[str, float] = {}
+    qcap: Optional[int] = None
+    sums = {"Dropped_tuples": 0, "Svc_failures": 0,
+            "Dead_letter_tuples": 0, "Shed_tuples": 0}
+    edges_balanced = True
+    final_check = True
+    committed: Optional[int] = None
+    workers: List[dict] = []
+    for stats in stats_list:
+        w = stats.get("Worker")
+        workers.append({"Worker": w,
+                        "PipeGraph_name": stats.get("PipeGraph_name")})
+        for op in stats.get("Operators") or ():
+            row = dict(op)
+            row["Worker"] = w
+            operators.append(row)
+        topo = (stats.get("Topology") or {}).get("Edges") or []
+        for e in topo:
+            key = tuple(e[:2])
+            if key not in edges_seen:
+                edges_seen.add(key)
+                topology.append(list(e))
+        for rec in stats.get("Trace_records") or ():
+            traces.append(rec)
+        for ev in stats.get("Flight") or ():
+            ev = dict(ev)
+            ev.setdefault("worker", w)
+            flight.append(ev)
+        for k in sums:
+            sums[k] += int(stats.get(k, 0) or 0)
+        cons = stats.get("Conservation")
+        if cons:
+            edges_balanced = edges_balanced \
+                and bool(cons.get("Edges_balanced"))
+            final_check = final_check and bool(cons.get("Final_check"))
+            cons_rows.extend(cons.get("Edges") or ())
+            for v in cons.get("Violations") or ():
+                v = dict(v)
+                v.setdefault("worker", w)
+                violations.append(v)
+        diag = stats.get("Diagnosis") or {}
+        for k, v in (diag.get("Sustained_depth") or {}).items():
+            sustained[k] = max(sustained.get(k, 0.0), float(v or 0.0))
+        if diag.get("Queue_capacity"):
+            qcap = max(qcap or 0, int(diag["Queue_capacity"]))
+        dur = stats.get("Durability")
+        if dur is not None:
+            c = int(dur.get("Committed_epoch", 0) or 0)
+            committed = c if committed is None else min(committed, c)
+    wire_rows = wire_table(stats_list)
+    for row in wire_rows:
+        if not row["balanced"]:
+            edges_balanced = False
+            # the consumer worker usually flagged this loss online
+            # already (transport STATS-trailer check); synthesize a
+            # violation only when no per-worker book carried it, so
+            # one loss never counts twice in the merged report
+            if not any(v.get("kind") == "lost_wire_delivery"
+                       and v.get("edge") == row["edge"]
+                       for v in violations):
+                violations.append({
+                    "kind": "lost_wire_delivery", "edge": row["edge"],
+                    "count": row["missing_tuples"],
+                    "frames": (row["frames_sent"]
+                               - row["frames_delivered"]),
+                })
+    flight.sort(key=lambda e: e.get("t", 0))
+    merged = {
+        "PipeGraph_name": first.get("PipeGraph_name", "?"),
+        "Schema_version": first.get("Schema_version"),
+        "Merged_workers": workers,
+        "Operators": operators,
+        "Operator_number": len(operators),
+        "Topology": {"Edges": topology} if topology else None,
+        "Trace_records": traces[-MAX_TRACES:],
+        "Flight": flight[-MAX_FLIGHT:],
+        "Conservation": {
+            "Edges_balanced": edges_balanced,
+            "Final_check": final_check,
+            "Violations_total": len(violations),
+            "Violations": violations,
+            "Edges": cons_rows[:MAX_EDGE_ROWS],
+            # wire edges already appear as the sender-side
+            # "wire:<consumer>" ledger rows; only count ones the
+            # per-worker books somehow missed
+            "Edges_total": len(cons_rows) + sum(
+                1 for r in wire_rows
+                if f"wire:{r['edge']}"
+                not in {c.get("edge") for c in cons_rows}),
+        },
+        "Wire": {
+            "Edges": wire_rows,
+            "Balanced": all(r["balanced"] for r in wire_rows),
+        },
+        # recompute inputs only: bottleneck/attribution re-derive over
+        # the merged operator set (diagnosis/report.py offline path)
+        "Diagnosis": {
+            "Sustained_depth": sustained,
+            "Queue_capacity": qcap,
+        } if (sustained or qcap) else None,
+        "Durability": ({"Committed_epoch": committed}
+                       if committed is not None else None),
+    }
+    merged.update(sums)
+    return merged
+
+
+def check_wire_conservation(stats_list: List[dict]) -> List[dict]:
+    """The cross-process final check: every wire edge balanced to the
+    tuple.  Returns violations ([] == the identity holds)."""
+    return [{"kind": "lost_wire_delivery", "edge": r["edge"],
+             "count": r["missing_tuples"]}
+            for r in wire_table(stats_list) if not r["balanced"]]

@@ -140,18 +140,42 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m windflow_tpu.doctor",
         description="Render the diagnosis report of a live dashboard "
                     "endpoint or an offline stats/flight dump.")
-    ap.add_argument("target",
+    ap.add_argument("targets", nargs="+",
                     help="dashboard URL (http://host:port), a dump "
-                         "directory, or one stats-JSON file")
+                         "directory, or stats-JSON file(s); several "
+                         "files with --merge fold into one report")
     ap.add_argument("--json", action="store_true",
                     help="emit the structured report as JSON instead "
                          "of text")
+    ap.add_argument("--merge", action="store_true",
+                    help="merge multiple per-worker stats dumps of one "
+                         "distributed run into ONE graph view "
+                         "(distributed/observe.py) before reporting")
     args = ap.parse_args(argv)
     try:
-        if args.target.startswith(("http://", "https://")):
-            triples = fetch_reports(args.target)
+        urls = [t for t in args.targets
+                if t.startswith(("http://", "https://"))]
+        if urls and (args.merge or len(args.targets) > 1):
+            raise ValueError(
+                "dashboard URLs take a single target without --merge "
+                "(the server already aggregates its apps); offline "
+                "merging works on stats-JSON files/directories")
+        if args.merge:
+            from .distributed.observe import merge_stats
+            loaded = []
+            for t in args.targets:
+                loaded.extend(load_stats(t))
+            merged = merge_stats([s for _l, s, _f in loaded])
+            triples = [("merged:" + ",".join(l for l, _s, _f in loaded),
+                        merged, merged.get("Flight"))]
+        elif len(args.targets) > 1:
+            triples = []
+            for t in args.targets:
+                triples.extend(load_stats(t))
+        elif urls:
+            triples = fetch_reports(args.targets[0])
         else:
-            triples = load_stats(args.target)
+            triples = load_stats(args.targets[0])
     except (ValueError, OSError) as e:
         print(f"doctor: {e}", file=sys.stderr)
         return 2

@@ -76,6 +76,15 @@ class EpochCoordinator(threading.Thread):
         self._sources: List[str] = []
         self._sinks: set = set()
         self._txn_sinks: List = []
+        # distributed plane (distributed/; docs/DISTRIBUTED.md): wire
+        # edges act as pseudo-sinks (a barrier leaving the worker) and
+        # pseudo-sources (a barrier arriving off the wire).  A worker
+        # with no local sources is a FOLLOWER: it never announces
+        # epochs itself -- epoch ids are global, owned by the source
+        # worker's coordinator, and observed here via remote_epoch.
+        self._wire_sinks: set = set()
+        self._wire_sources: List[str] = []
+        self.follower = False
         self._gap = 0                 # >0: epoch announcing held (rescale)
         # epoch currently inside _commit (popped from _pending but not
         # yet durable): checkpoint_now/hold_epochs must not mistake the
@@ -166,6 +175,8 @@ class EpochCoordinator(threading.Thread):
         from ..runtime.node import source_loop_of
         g = self.graph
         sinks, sources, txn = set(), [], []
+        wire_out = set(getattr(g, "_wire_out_edges", ()))
+        wire_in = list(getattr(g, "_wire_in_edges", ()))
         with self._cond:
             for n in g._all_nodes():
                 n.epoch_coord = self
@@ -192,9 +203,17 @@ class EpochCoordinator(threading.Thread):
                         logic._coordinated = True
                         logic._dead_letters = g.dead_letters
                         logic._name = name
-            self._sinks = sinks
-            self._sources = sources
+            self._wire_sinks = wire_out
+            self._wire_sources = wire_in
+            self.follower = not sources and bool(wire_in)
+            self._sinks = sinks | wire_out
+            self._sources = sources + wire_in
             self._txn_sinks = txn
+        # the transport acks/finishes through the coordinator: bind it
+        dist = getattr(g, "_dist", None)
+        if dist is not None:
+            for s in dist.senders.values():
+                s.epoch_coord = self
 
     # -- collection (replica threads) ----------------------------------
     def add_snapshot(self, epoch: int, states: Dict[str, bytes]) -> None:
@@ -226,6 +245,34 @@ class EpochCoordinator(threading.Thread):
                 self._final_states[k] = v
             self._cond.notify_all()
 
+    def remote_epoch(self, epoch: int, name: str, frontier=None) -> None:
+        """A barrier for ``epoch`` arrived off the wire (distributed
+        plane, receiver thread, BEFORE the barrier enters the consumer
+        channel).  Epoch ids are global -- announced by the source
+        worker's coordinator -- so a follower catches its ``epoch_seq``
+        up here, creating the pending entries the local cuts will fill;
+        a worker that also has local sources (the leader hearing its
+        own epochs echoed through a cycle) just records the injection."""
+        if epoch < 1:
+            return
+        first = False
+        with self._cond:
+            if epoch > self.epoch_seq:
+                for e in range(self.epoch_seq + 1, epoch + 1):
+                    if e > self.committed and e not in self._pending:
+                        self._pending[e] = _PendingEpoch(_time.monotonic())
+                        first = True
+                self.epoch_seq = epoch
+            p = self._pending.get(epoch)
+            if p is not None:
+                p.injected.add(name)
+                if frontier is not None:
+                    p.offsets[name] = frontier
+            self._cond.notify_all()
+        if first:
+            self.graph.flight.record("epoch_observe", epoch=epoch,
+                                     edge=name)
+
     # -- epoch cadence -------------------------------------------------
     def begin_epoch(self) -> int:
         g = self.graph
@@ -253,7 +300,9 @@ class EpochCoordinator(threading.Thread):
                     clear = self._gap == 0 and not self._stopping
                 pausing = (g._pause_ctl is not None
                            and g._pause_ctl.pausing)
-                if clear and not pausing:
+                # a distributed follower never announces: its epochs
+                # arrive off the wire with the leader's global ids
+                if clear and not pausing and not self.follower:
                     try:
                         self.begin_epoch()
                     except Exception:  # pragma: no cover - never die

@@ -67,6 +67,22 @@ def _is_elastic(node: RtNode) -> bool:
     return getattr(node, "elastic_group", None) is not None
 
 
+def _partition_splits(graph, a: RtNode, b: RtNode) -> bool:
+    """Distributed-runtime fusion barrier (distributed/partition.py):
+    a fused node runs as ONE replica thread in ONE worker process, so
+    two nodes the partition plan assigns to different workers must not
+    fuse -- the edge between them is exactly the cut the shuffle
+    transport carries.  No-op outside distributed runs (plan absent)."""
+    plan = getattr(graph, "_dist_plan", None)
+    if plan is None:
+        return False
+    from ..distributed.partition import node_owner
+    try:
+        return node_owner(a, plan) != node_owner(b, plan)
+    except KeyError:
+        return False  # node outside the plan (defensive): fuse freely
+
+
 def _is_ingest_head(node: RtNode) -> bool:
     try:
         from ..ingest.sources import IngestSourceLogic
@@ -173,7 +189,7 @@ def _try_linear(graph, consumers: dict) -> bool:
         ch, _outlet = sfd
         b = consumers.get(id(ch))
         if b is None or b is a or _is_collector(b) or _is_elastic(b) \
-                or not _tick_safe(a, b):
+                or not _tick_safe(a, b) or _partition_splits(graph, a, b):
             continue
         _merge(graph, a, b)
         return True
@@ -214,6 +230,9 @@ def _try_stage_pattern(graph, consumers: dict) -> bool:
                 any(c in producers for c in cons):
             continue
         if any(not _tick_safe(a, b) for a, b in zip(producers, cons)):
+            continue
+        if any(_partition_splits(graph, a, b)
+               for a, b in zip(producers, cons)):
             continue
         for a, b in zip(producers, cons):
             a.outlets = []      # drop the fan-out wiring first

@@ -146,6 +146,7 @@ class MultiPipe:
             node = RtNode(f"{self.name}/{stage.name}.{i}", logic, in_ch, [])
             if stage.error_policy is not None:
                 node.error_policy = stage.error_policy
+            node.worker_pin = stage.worker
             node.group = stage.groups[i] if stage.groups is not None else None
             if self.graph.config.tracing:
                 node.stats = self.graph.stats.register(
@@ -225,8 +226,11 @@ class MultiPipe:
             raise RuntimeError("source already present")
         self._mark_used(source)
         stage = source.stages()[0]
+        if stage.worker is None:
+            stage.worker = getattr(source, "worker", None)
         for i, logic in enumerate(stage.replicas):
             node = RtNode(f"{self.name}/{stage.name}", logic, None, [])
+            node.worker_pin = stage.worker
             # per-source trace-sampling override (telemetry/;
             # SourceBuilder.with_tracing): None defers to
             # RuntimeConfig.trace_sample, 0 opts out
@@ -263,6 +267,8 @@ class MultiPipe:
         for i, stage in enumerate(stages):
             if stage.error_policy is None:
                 stage.error_policy = getattr(op, "error_policy", "fail")
+            if stage.worker is None:
+                stage.worker = getattr(op, "worker", None)
             if i == 0:
                 self._swap_cb_broadcast(stage, win_type)
             self._append_stage(stage, win_type)
@@ -329,6 +335,14 @@ class MultiPipe:
         (multipipe.hpp:345-390; chain exists only for Filter/Map/
         FlatMap/Sink)."""
         self._check_open()
+        pin = getattr(op, "worker", None)
+        if pin is not None and any(t.worker_pin is not None
+                                   and t.worker_pin != pin
+                                   for t in self.tails):
+            # thread fusion would co-locate by construction: a pin that
+            # differs from the tail's must keep its own node so the
+            # partition planner can cut the edge (docs/DISTRIBUTED.md)
+            return self.add(op)
         if getattr(op, "elasticity", None) is not None \
                 or any(t.elastic_group is not None for t in self.tails):
             # thread fusion and runtime rescaling are mutually
@@ -354,6 +368,11 @@ class MultiPipe:
                 self._mark_used(op)
                 self.tails[0].logic = ChainedLogic(self.tails[0].logic,
                                                    stages[0].replicas[0])
+                if pin is not None:
+                    # the pin survives chaining by pinning the merged
+                    # node (a chained operator shares its tail's thread
+                    # by construction, so the whole node moves)
+                    self.tails[0].worker_pin = pin
                 self._op_names.append(f"{op.name}(chained)")
                 return self
         if (logics is None or len(logics) != len(self.tails)
@@ -362,6 +381,8 @@ class MultiPipe:
         self._mark_used(op)
         for tail, logic in zip(self.tails, logics):
             tail.logic = ChainedLogic(tail.logic, logic)
+            if pin is not None:
+                tail.worker_pin = pin
         self._op_names.append(f"{op.name}(chained)")
         return self
 

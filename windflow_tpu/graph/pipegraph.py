@@ -93,6 +93,12 @@ class PipeGraph:
         # epoch barriers + manifest commits + exactly-once sink
         # release, built at start() when RuntimeConfig.durability is set
         self.durability = None
+        # distributed runtime plane (distributed/; docs/DISTRIBUTED.md):
+        # the partition plan (node name -> worker id, computed before
+        # the fusion pass) and the live transport handle, built at
+        # start() when RuntimeConfig.distributed is set
+        self._dist_plan = None
+        self._dist = None
 
     # -- construction ------------------------------------------------------
     def _new_pipe(self) -> MultiPipe:
@@ -252,6 +258,15 @@ class PipeGraph:
         from ..runtime.node import FusedLogic, SourcePauseControl, \
             source_loop_of
         self._pause_ctl = SourcePauseControl()
+        # distributed runtime (distributed/partition.py): the partition
+        # plan must exist BEFORE the fusion pass (its partition barrier
+        # keeps fused nodes inside one worker) and is a pure function
+        # of the wired pre-fusion topology + pins, so every worker
+        # computes the same plan independently
+        if self.config.distributed is not None \
+                and self._dist_plan is None:
+            from ..distributed.partition import plan_partition
+            plan_partition(self)
         # graph compile pass (graph/fuse.py): at OptLevel.LEVEL2 (the
         # default; RuntimeConfig.opt_level opts out) adjacent
         # single-producer FORWARD stages fuse into single replicas.
@@ -260,6 +275,14 @@ class PipeGraph:
         # fault plans bind per fused segment.
         from .fuse import fuse_graph
         self.fused_nodes = fuse_graph(self)
+        # distributed runtime (distributed/wiring.py): prune to this
+        # worker's partition and wire the shuffle transport -- AFTER
+        # fusion (the node set is final) and BEFORE the planner /
+        # ingest wiring / audit attachment, so those planes see only
+        # the owned nodes and the post-distribution destination set
+        if self.config.distributed is not None:
+            from ..distributed.wiring import distribute_graph
+            distribute_graph(self)
         # cost-based placement planner (graph/planner.py;
         # docs/PLANNER.md): resolve every window engine's lane
         # ('auto' -> measured cost model; pins pass through), hand the
@@ -432,6 +455,12 @@ class PipeGraph:
     def wait_end(self) -> None:
         errors, stuck = self._join_all()
         self._ended = True
+        if self._dist is not None:
+            # distributed plane: flush the wire tails (acks settle the
+            # senders' replay buffers, so the ledger closes over the
+            # socket edges) before the auditor's final check
+            self._dist.stop(
+                clean=not errors and not self._cancel.cancelled)
         if self._controller is not None:
             self._controller.stop()
         if self._watchdog is not None:
@@ -499,9 +528,11 @@ class PipeGraph:
                 "high_watermark": getattr(ch, "high_watermark", 0),
                 "residual": ch.qsize(),
             })
+        from ..distributed.identity import worker_suffix
         os.makedirs(self.config.log_dir, exist_ok=True)
-        path = os.path.join(self.config.log_dir,
-                            f"{os.getpid()}_{self.name}_runtime.json")
+        path = os.path.join(
+            self.config.log_dir,
+            f"{os.getpid()}_{self.name}{worker_suffix()}_runtime.json")
         with open(path, "w") as f:
             json.dump({"graph": self.name, "channels": rows}, f, indent=1)
 
@@ -517,16 +548,19 @@ class PipeGraph:
             # end-of-run state (sustained-pressure EWMAs survive the
             # drain, so an offline doctor still names the bottleneck)
             self.diagnosis.maybe_tick(force=True)
+        from ..distributed.identity import worker_suffix
         d = self.config.log_dir
         os.makedirs(d, exist_ok=True)
-        pid = os.getpid()
-        with open(os.path.join(d, f"{pid}_{self.name}.json"), "w") as f:
+        # worker-id component (distributed/identity.py): two workers of
+        # one graph on one box must never clobber each other's dumps
+        stem = f"{os.getpid()}_{self.name}{worker_suffix()}"
+        with open(os.path.join(d, f"{stem}.json"), "w") as f:
             f.write(self.stats.to_json(self.get_num_dropped_tuples(),
                                        self.dead_letters.count(),
                                        flight_events=self.flight.snapshot()))
-        with open(os.path.join(d, f"{pid}_{self.name}.dot"), "w") as f:
+        with open(os.path.join(d, f"{stem}.dot"), "w") as f:
             f.write(graph_to_dot(self))
-        with open(os.path.join(d, f"{pid}_{self.name}.svg"), "w") as f:
+        with open(os.path.join(d, f"{stem}.svg"), "w") as f:
             f.write(graph_to_svg(self))
 
     def run(self) -> None:
@@ -688,6 +722,11 @@ class PipeGraph:
         (monitoring reporter + log dump); cheap -- lock-free depth
         reads (runtime/queues.Channel.depth)."""
         from ..runtime.node import FusedLogic
+        if self._dist is not None:
+            # distributed plane: refresh the per-edge wire books
+            # (stats-JSON ``Wire`` block, merged cross-worker by
+            # distributed/observe.py)
+            self.stats.set_wire(self._dist.wire_block())
         for n in self._all_nodes():
             logic = n.logic
             rec = n.stats

@@ -31,7 +31,9 @@ mid-recv), and the controller binds to downstream device window
 engines.
 """
 from .admission import (ADMISSION_POLICIES, AdmissionConfig, ShedTuples)
-from .codec import StreamDecoder, decode_batch, encode_batch
+# codec promoted to the shared wire module (distributed/wire.py); the
+# names stay re-exported here for the historical surface
+from ..distributed.wire import StreamDecoder, decode_batch, encode_batch
 from .controller import MicrobatchController
 from .credits import CreditGate, CreditedChannel
 from .feed import FeedSource, ParallelColumnFeeder

@@ -1,117 +1,41 @@
-"""Wire codec for columnar tuple frames (the SocketSource protocol).
+"""Deprecation shim: the wire codec moved to
+:mod:`windflow_tpu.distributed.wire`.
 
-One frame carries one ``TupleBatch`` as a length-prefixed columnar
-payload -- the network twin of the in-process struct-of-arrays
-currency, so a decoded frame enters the batch plane zero-copy (each
-column is a view over the receive buffer):
-
-    [magic 'WFB1'][u32 payload_len] payload:
-        [u16 n_cols] then per column:
-            [u8 name_len][name utf-8][u8 dtype tag][u32 byte_len][raw LE]
-
-Supported dtypes cover the control columns (int64) and the usual
-payload columns; anything else must be mapped by the producer.  The
-:class:`StreamDecoder` is incremental: feed it arbitrary byte chunks
-off a non-blocking socket and it yields complete batches as they
-frame up.
+The ingest plane's framed-TCP protocol and the inter-worker shuffle
+transport (docs/DISTRIBUTED.md) share one codec; it lives with the
+distributed plane now.  This module keeps the historical import path
+(``windflow_tpu.ingest.codec``) working: the frozen legacy surface
+(``encode_batch``/``decode_batch``/``StreamDecoder``/``MAGIC``)
+re-exports silently -- existing callers must not start warning on a
+pure code move -- while any NEW wire-layer name reached through this
+path warns once per process, pointing the caller at the canonical
+``windflow_tpu.distributed.wire`` home.
 """
 from __future__ import annotations
 
-import struct
-from typing import List, Optional
+import warnings
 
-import numpy as np
+from ..distributed.wire import (  # noqa: F401  (re-exported surface)
+    MAGIC, StreamDecoder, decode_batch, encode_batch,
+)
 
-from ..core.tuples import TupleBatch
-
-MAGIC = b"WFB1"
-_HEADER = struct.Struct("<4sI")
-
-_DTYPE_TAGS = {
-    np.dtype("<i8"): 0, np.dtype("<f8"): 1,
-    np.dtype("<i4"): 2, np.dtype("<f4"): 3,
-}
-_TAG_DTYPES = {v: k for k, v in _DTYPE_TAGS.items()}
+_warned = False
 
 
-def encode_batch(batch: TupleBatch) -> bytes:
-    """One framed wire message for ``batch``."""
-    parts = [struct.pack("<H", len(batch.cols))]
-    for name, col in batch.cols.items():
-        col = np.ascontiguousarray(col)
-        if col.dtype not in _DTYPE_TAGS:
-            # normalize exotic ints/floats instead of refusing the batch
-            col = col.astype(np.float64 if col.dtype.kind == "f"
-                             else np.int64)
-        raw = col.tobytes()
-        nb = name.encode("utf-8")
-        if len(nb) > 255:
-            raise ValueError(f"column name too long: {name!r}")
-        parts.append(struct.pack("<B", len(nb)))
-        parts.append(nb)
-        parts.append(struct.pack("<BI", _DTYPE_TAGS[col.dtype], len(raw)))
-        parts.append(raw)
-    payload = b"".join(parts)
-    return _HEADER.pack(MAGIC, len(payload)) + payload
+def _warn_moved() -> None:
+    global _warned
+    if not _warned:
+        _warned = True
+        warnings.warn(
+            "windflow_tpu.ingest.codec moved to "
+            "windflow_tpu.distributed.wire; update imports "
+            "(the old path keeps working for now)",
+            DeprecationWarning, stacklevel=3)
 
 
-def decode_batch(payload: bytes) -> TupleBatch:
-    """Decode one frame payload (without the 8-byte header)."""
-    view = memoryview(payload)
-    (n_cols,) = struct.unpack_from("<H", view, 0)
-    off = 2
-    cols = {}
-    for _ in range(n_cols):
-        (name_len,) = struct.unpack_from("<B", view, off)
-        off += 1
-        name = bytes(view[off:off + name_len]).decode("utf-8")
-        off += name_len
-        tag, nbytes = struct.unpack_from("<BI", view, off)
-        off += 5
-        if tag not in _TAG_DTYPES:
-            raise ValueError(f"unknown dtype tag {tag} in frame")
-        cols[name] = np.frombuffer(view[off:off + nbytes],
-                                   dtype=_TAG_DTYPES[tag])
-        off += nbytes
-    return TupleBatch(cols)
-
-
-class StreamDecoder:
-    """Incremental frame decoder over a byte stream."""
-
-    def __init__(self, max_frame_bytes: int = 1 << 28):
-        self._buf = bytearray()
-        self.max_frame_bytes = max_frame_bytes
-        self.frames_decoded = 0
-
-    def feed(self, data: bytes) -> List[TupleBatch]:
-        """Append received bytes; return every now-complete batch."""
-        self._buf.extend(data)
-        out: List[TupleBatch] = []
-        while True:
-            frame = self._next_frame()
-            if frame is None:
-                return out
-            out.append(frame)
-
-    def _next_frame(self) -> Optional[TupleBatch]:
-        if len(self._buf) < _HEADER.size:
-            return None
-        magic, length = _HEADER.unpack_from(bytes(self._buf[:_HEADER.size]))
-        if magic != MAGIC:
-            raise ValueError(f"bad frame magic {magic!r} (stream desync)")
-        if length > self.max_frame_bytes:
-            raise ValueError(f"frame of {length} bytes exceeds the "
-                             f"{self.max_frame_bytes} limit")
-        end = _HEADER.size + length
-        if len(self._buf) < end:
-            return None
-        # copy the payload out so decoded columns do not pin (or get
-        # corrupted by) the growing receive buffer
-        payload = bytes(self._buf[_HEADER.size:end])
-        del self._buf[:end]
-        self.frames_decoded += 1
-        return decode_batch(payload)
-
-    def pending_bytes(self) -> int:
-        return len(self._buf)
+def __getattr__(name):  # anything beyond the frozen legacy surface
+    from ..distributed import wire as _wire
+    if hasattr(_wire, name):
+        _warn_moved()
+        return getattr(_wire, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

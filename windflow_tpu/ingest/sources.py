@@ -19,13 +19,13 @@ import numpy as np
 from ..core.basic import Pattern, RoutingMode
 from ..core.context import RuntimeContext
 from ..core.tuples import TupleBatch
+from ..distributed.wire import StreamDecoder
 from ..operators.base import Operator, StageSpec
 from ..resilience.cancel import GraphCancelled
 from ..runtime.emitters import StandardEmitter
 from ..runtime.node import SourceLoopLogic
 from .admission import AdmissionConfig, ShedTuples
 from .coalesce import ChunkCoalescer
-from .codec import StreamDecoder
 from .controller import MicrobatchController
 from .credits import CreditGate
 
@@ -558,7 +558,7 @@ def serve_batches(sock: socket.socket,
                   batches: Sequence[TupleBatch]) -> int:
     """Test/bench helper: send ``batches`` as codec frames over an
     accepted connection; returns bytes sent."""
-    from .codec import encode_batch
+    from ..distributed.wire import encode_batch
     total = 0
     for b in batches:
         data = encode_batch(b)

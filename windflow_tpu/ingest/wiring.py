@@ -61,6 +61,13 @@ def wire_ingest(graph) -> None:
         consumers: Dict[int, object] = {}
         for outlet in n.outlets:
             for di, (ch, pid) in enumerate(outlet.dests):
+                if getattr(ch, "is_wire_sender", False):
+                    # distributed plane: a cross-worker destination has
+                    # its OWN credit window spanning the socket
+                    # (distributed/transport.py); the in-process proxy
+                    # would starve -- its releases happen in another
+                    # process
+                    continue
                 proxy = proxies.get(id(ch))
                 if proxy is None:
                     proxy = proxies[id(ch)] = CreditedChannel(ch)

@@ -240,8 +240,13 @@ class MonitoringThread(threading.Thread):
         accumulate in ``log_dir`` without bound; rotation keeps the
         newest ``RuntimeConfig.snapshot_keep`` snapshot files (default
         16; <= 0 disables rotation)."""
+        from ..distributed.identity import worker_suffix
         d = self.graph.config.log_dir
-        path = os.path.join(d, f"{os.getpid()}_{self.graph.name}_stats.json")
+        # worker-id component (distributed/identity.py): two workers of
+        # one graph on one box never clobber each other's snapshots
+        path = os.path.join(
+            d,
+            f"{os.getpid()}_{self.graph.name}{worker_suffix()}_stats.json")
         self.snapshot_path = path
 
         def write():

@@ -24,10 +24,12 @@ from ..telemetry.histogram import LogHistogram
 # 3 = the diagnosis-plane layout (adds Topology / Diagnosis / History /
 # optional Flight on top of the PR 7 telemetry and PR 9 audit blocks).
 # 4 = adds the optional Durability block (epoch coordinator gauges).
+# 5 = adds the optional Worker id + Wire block (distributed runtime's
+# per-edge wire delivery books; distributed/observe.py merges them).
 # Readers (doctor CLI, dashboard /explain, tests) must tolerate MISSING
 # blocks rather than dispatch on this number: older dumps carry no
 # version field at all, and every block is optional by contract.
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 
 
 @dataclass
@@ -236,6 +238,11 @@ class GraphStats:
         # latest epoch-coordinator gauges (committed epoch, lag,
         # commit wall time, stall flag), published per commit/tick
         self.durability: Optional[dict] = None
+        # distributed runtime plane (distributed/; docs/DISTRIBUTED.md):
+        # this process's worker id (None = single-process graph) and
+        # the latest per-edge wire delivery books, refreshed per report
+        self.worker: Optional[int] = None
+        self.wire: Optional[dict] = None
 
     def register(self, operator_name: str, replica_id: str) -> StatsRecord:
         rec = StatsRecord(operator_name, replica_id)
@@ -305,6 +312,13 @@ class GraphStats:
         with self.lock:
             self.durability = block
 
+    def set_wire(self, block: dict) -> None:
+        """Publish the distributed plane's per-edge wire books
+        (distributed/wiring.DistRuntime.wire_block, per gauge
+        refresh)."""
+        with self.lock:
+            self.wire = block
+
     def to_json(self, dropped_tuples: int = 0,
                 dead_letter_tuples: int = 0,
                 flight_events: Optional[List[dict]] = None) -> str:
@@ -342,6 +356,8 @@ class GraphStats:
             diagnosis = self.diagnosis
             history = self.history
             durability = self.durability
+            worker = self.worker
+            wire = self.wire
             latency_e2e = None
             trace_records: List[dict] = []
             if self.histograms:
@@ -407,6 +423,13 @@ class GraphStats:
             # lag of the oldest uncommitted epoch, last commit wall
             # time, stall flag; None with the plane disabled
             "Durability": durability,
+            # distributed runtime plane (distributed/;
+            # docs/DISTRIBUTED.md): this process's worker id and the
+            # per-edge wire delivery books; None/absent outside
+            # distributed runs.  distributed/observe.merge_stats folds
+            # N such dumps into one graph view.
+            "Worker": worker,
+            "Wire": wire,
             "Memory_usage_KB": get_mem_usage_kb(),
             "Operator_number": len(ops),
             "Operators": ops,

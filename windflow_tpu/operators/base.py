@@ -47,6 +47,9 @@ class StageSpec:
     # from the operator descriptor at wiring (resilience/policies.py);
     # applies to the stage's replica nodes, never to collectors
     error_policy: Optional[str] = None
+    # distributed-runtime worker pin, filled from the operator
+    # descriptor at wiring (distributed/; docs/DISTRIBUTED.md)
+    worker: Optional[int] = None
     # elastic scaling (elastic/; docs/ELASTIC.md): the operator's
     # ElasticSpec plus a ``(replica_index, parallelism) -> NodeLogic``
     # factory, filled by MultiPipe.add for single-stage operators that
@@ -58,6 +61,10 @@ class StageSpec:
 
 class Operator:
     """Base descriptor: name, parallelism, routing, pattern."""
+
+    # (class-level default so pre-existing Operator subclasses that
+    # override __init__ without chaining still read as unpinned)
+    worker: Optional[int] = None
 
     def __init__(self, name: str, parallelism: int, routing: RoutingMode,
                  pattern: Pattern):
@@ -74,6 +81,9 @@ class Operator:
         # ElasticSpec when the builder declared .with_elasticity(...)
         # (elastic/; docs/ELASTIC.md); None = fixed parallelism
         self.elasticity = None
+        # distributed-runtime worker pin (.with_worker(i)); None =
+        # placed by the partition planner (docs/DISTRIBUTED.md)
+        self.worker = None
 
     # -- to be provided by subclasses --------------------------------------
     def stages(self) -> List[StageSpec]:

@@ -103,6 +103,53 @@ class _PutRule:
         self.action = action
 
 
+class _LinkDropRule:
+    """Nth-frame wire loss on a shuffle edge (distributed/transport.py):
+    the frame is counted as sent intent but never hits the socket --
+    the cross-process conservation surfaces must flag it."""
+
+    __slots__ = ("edge_substr", "at_frame")
+
+    def __init__(self, edge_substr: str, at_frame: int):
+        self.edge_substr = edge_substr
+        self.at_frame = at_frame
+
+
+class _LinkDelayRule:
+    """Per-frame send delay on a shuffle edge (a slow / congested
+    link), seeded jitter like delay_puts."""
+
+    __slots__ = ("edge_substr", "delay_s", "every_n")
+
+    def __init__(self, edge_substr: str, delay_s: float, every_n: int):
+        self.edge_substr = edge_substr
+        self.delay_s = delay_s
+        self.every_n = every_n
+
+
+class LinkFaults:
+    """Per-sender link fault state (bound by the distributed wiring;
+    own counters, so injection is deterministic per edge)."""
+
+    __slots__ = ("edge", "drops", "delays")
+
+    def __init__(self, edge: str, drops: List[_LinkDropRule],
+                 delays: List[_LinkDelayRule]):
+        self.edge = edge
+        self.drops = drops
+        self.delays = delays
+
+    def drop_frame(self, frame_no: int) -> bool:
+        """True when the sender's ``frame_no``-th frame (1-based, per
+        edge) must be lost on the wire."""
+        return any(frame_no == r.at_frame for r in self.drops)
+
+    def maybe_delay(self, frame_no: int) -> None:
+        for r in self.delays:
+            if frame_no % r.every_n == 0:
+                time.sleep(r.delay_s)
+
+
 class _EpochCrashRule:
     """Barrier-window crash (durability/): the replica dies while
     taking its epoch cut for ``epoch`` -- deterministic on the epoch
@@ -185,6 +232,11 @@ class FaultPlan:
         self._delays: List[_DelayRule] = []
         self._put_rules: List[_PutRule] = []
         self._epoch_crashes: List[_EpochCrashRule] = []
+        # network actions (distributed/; docs/DISTRIBUTED.md), consumed
+        # at the shuffle-transport layer
+        self._link_drops: List[_LinkDropRule] = []
+        self._link_delays: List[_LinkDelayRule] = []
+        self._kills: dict = {}          # worker id -> at_tuple
         # epochs whose manifest commit is torn (read by the
         # EpochCoordinator; graph-global, no node binding)
         self.torn_commit_epochs: set = set()
@@ -250,6 +302,56 @@ class FaultPlan:
             raise ValueError("epoch ids are 1-based")
         self.torn_commit_epochs.add(int(epoch))
         return self
+
+    # -- network actions (distributed/; docs/DISTRIBUTED.md) ----------
+    def drop_link(self, edge_substr: str, at_frame: int) -> "FaultPlan":
+        """The matching shuffle edge's Nth frame (1-based, counted at
+        the sender across reconnects) is silently lost on the wire:
+        sent intent counted, never delivered.  The receiver must flag
+        the sequence gap and the STATS-trailer shortfall with the
+        exact edge and tuple count, and the cross-process merge must
+        fail the conservation identity by exactly that much."""
+        if at_frame < 1:
+            raise ValueError("at_frame is 1-based")
+        self._link_drops.append(_LinkDropRule(edge_substr, at_frame))
+        return self
+
+    def delay_link(self, edge_substr: str, delay_ms: float,
+                   every_n: int = 1) -> "FaultPlan":
+        """Sleep ``delay_ms`` before every ``every_n``-th frame send on
+        matching shuffle edges -- a slow link whose backpressure must
+        throttle the remote producer through the credit window."""
+        if every_n < 1:
+            raise ValueError("every_n must be >= 1")
+        self._link_delays.append(
+            _LinkDelayRule(edge_substr, delay_ms / 1e3, every_n))
+        return self
+
+    def kill_worker(self, worker: int, at_tuple: int) -> "FaultPlan":
+        """Hard-kill worker ``worker`` (``os._exit``, no teardown) when
+        its transport tuple clock -- tuples sent plus received over its
+        shuffle edges -- reaches ``at_tuple``.  Deterministic per
+        worker; the run_distributed restart loop must recover from the
+        newest globally-committed epoch."""
+        if at_tuple < 1:
+            raise ValueError("at_tuple is 1-based")
+        self._kills[int(worker)] = int(at_tuple)
+        return self
+
+    def for_link(self, edge_name: str):
+        """Link fault state for one shuffle edge (bound per sender by
+        the distributed wiring); None when no rule matches."""
+        drops = [r for r in self._link_drops
+                 if r.edge_substr in edge_name]
+        delays = [r for r in self._link_delays
+                  if r.edge_substr in edge_name]
+        if not drops and not delays:
+            return None
+        return LinkFaults(edge_name, drops, delays)
+
+    def kill_tuple_for(self, worker: int):
+        """The kill threshold of ``worker``'s transport clock, or None."""
+        return self._kills.get(int(worker))
 
     def fail_native_build(self) -> "FaultPlan":
         """Force the native toolchain probe to fail from now until

@@ -644,6 +644,11 @@ class RtNode(threading.Thread):
         # wiring marks collector nodes (ordering/K-slack/farm merge)
         # structurally; the fusion pass must never fuse across them
         self.is_collector = False
+        # distributed runtime (distributed/partition.py): the builder's
+        # .with_worker(i) pin, copied from the operator at wiring; the
+        # partition planner and the fusion pass's partition barrier
+        # read it.  None = placed automatically.
+        self.worker_pin = None
         # elastic-operator membership (elastic/rescale.py): the handle
         # key when this replica belongs to a runtime-rescalable stage.
         # The compile pass must not fuse such nodes (rescale rebuilds

@@ -54,9 +54,14 @@ class FlightRecorder:
         if not self.enabled:
             return None
         try:
+            # worker-id component (distributed/identity.py): a worker's
+            # post-mortem must not clobber its box-mates'
+            from ..distributed.identity import worker_suffix
             os.makedirs(log_dir, exist_ok=True)
             path = os.path.join(
-                log_dir, f"{os.getpid()}_{graph_name}_flight.jsonl")
+                log_dir,
+                f"{os.getpid()}_{graph_name}{worker_suffix()}"
+                "_flight.jsonl")
             with open(path, "w") as f:
                 for ev in self.snapshot():
                     f.write(json.dumps(ev, default=str) + "\n")
