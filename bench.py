@@ -925,6 +925,88 @@ def run_diagnosis_overhead(n_events):
     return rate_on, rate_off, overhead, w_on, summary
 
 
+def run_slo_overhead(n_events):
+    """Config #13: the SLO-plane + live-push overhead gate
+    (docs/OBSERVABILITY.md "SLO plane" / "Live cluster view").  The
+    identical traced 2f-style feed runs with the mission-control plane
+    ON -- declared objectives evaluated as burn rates on every
+    diagnosis tick, plus a StatsPusher streaming stats + flight deltas
+    to a live ClusterObserver -- vs OFF (no objectives, no pusher).
+    Interleaved best-of-3, identical results asserted: the plane is
+    purely observational, it never touches the item path.  The ON lane
+    additionally asserts the observer actually received pushes and the
+    Slo block reached the merged live view.  Returns (rate_on,
+    rate_off, overhead_frac, windows, slo_summary)."""
+    import warnings
+    import windflow_tpu as wf
+    from windflow_tpu.distributed.observe import (ClusterObserver,
+                                                  attach_pusher)
+    from windflow_tpu.operators.batch_ops import BatchSource
+    from windflow_tpu.operators.basic_ops import Sink
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
+    from windflow_tpu.slo import SloConfig
+
+    n_events = max(int(n_events), 8_000_000)
+
+    def one(slo_on):
+        src = _template_source(n_events, {}, SOURCE_BATCH)
+        cfg = wf.RuntimeConfig(tracing=True, diagnosis_interval_s=0.25)
+        if slo_on:
+            # generous objectives: the lane measures evaluation cost,
+            # not a breach storm (a breach changes no results either
+            # way -- the block below asserts the plane was live)
+            cfg.slo = SloConfig(p99_ms=1e9, min_throughput_rps=0.001)
+        g = wf.PipeGraph("bench13", wf.Mode.DEFAULT, config=cfg)
+        op = WinSeqTPU("sum", WIN, SLIDE, wf.WinType.TB,
+                       batch_len=DEVICE_BATCH, emit_batches=True,
+                       max_buffer_elems=MAX_BUFFER,
+                       inflight_depth=INFLIGHT)
+        sink = _CountSink()
+        g.add_source(BatchSource(src, SOURCE_PARALLELISM)).add(op) \
+            .add_sink(Sink(sink))
+        obs = pusher = None
+        t0 = time.perf_counter()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # dashboard-less fallback
+            g.start()
+            if slo_on:
+                obs = ClusterObserver()
+                obs.start()
+                pusher = attach_pusher(g, obs.host, obs.port, 0.25)
+            g.wait_end()
+        dt = time.perf_counter() - t0
+        slo_live = None
+        if slo_on:
+            pusher.stop()
+            # sendall returns before the observer thread parses the
+            # final frame: wait for the ingest to catch up
+            deadline = time.monotonic() + 10.0
+            while obs.pushes < pusher.pushes \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            merged = obs.merged()
+            obs.stop()
+            slo_live = (merged or {}).get("Slo")
+            assert pusher.pushes >= 1, "live push never fired"
+            assert slo_live is not None, \
+                "Slo block never reached the live merged view"
+        return n_events / dt, sink.windows, sink.total, slo_live
+
+    offs, ons = [], []
+    for _ in range(3):
+        offs.append(one(False))
+        ons.append(one(True))
+    rate_off, w_off, tot_off, _s = max(offs, key=lambda r: r[0])
+    rate_on, w_on, tot_on, slo_live = max(ons, key=lambda r: r[0])
+    assert w_on == w_off and tot_on == tot_off, \
+        "SLO/live-push plane changed results"
+    overhead = 1.0 - rate_on / rate_off if rate_off else 0.0
+    summary = {"slo_ticks": (slo_live or {}).get("Ticks", 0),
+               "breaches": (slo_live or {}).get("Breaches_total", 0),
+               "budget_burned": (slo_live or {}).get("Budget_burned")}
+    return rate_on, rate_off, overhead, w_on, summary
+
+
 def run_checkpoint_overhead(n_events, interval_s=1.0):
     """Config #11: the durability-plane overhead gate
     (docs/RESILIENCE.md "Exactly-once epochs").  The identical 2f-style
@@ -1070,10 +1152,15 @@ def run_distributed_shuffle(n_events):
     rate_1p = n_events / (time.perf_counter() - t0)
     # 2-process lane (includes worker spawn: the honest wall clock)
     t0 = time.perf_counter()
+    # observe=False: this lane measures the TRANSPORT; the live
+    # mission-control plane's cost has its own gated config
+    # (13_slo_overhead), and letting it ride here would bake its
+    # overhead invisibly into the shuffle baseline
     report = run_distributed(bench12_build, n_workers=2,
                              config_fn=bench12_config,
                              graph_name="bench12",
-                             workdir="log/bench12", timeout_s=900.0)
+                             workdir="log/bench12", timeout_s=900.0,
+                             observe=False)
     rate_2p = n_events / (time.perf_counter() - t0)
     merged = report["merged"]
     wire_rows = (merged.get("Wire") or {}).get("Edges") or []
@@ -1407,6 +1494,17 @@ def main():
         "vs_1proc": round(r12_2p / r12_1p, 2) if r12_1p else None,
         "tuples_conserved": cons12,
         **dist12}
+    # mission-control plane overhead (docs/OBSERVABILITY.md "SLO
+    # plane" / "Live cluster view"): identical traced feed with
+    # declared objectives + live stats pushing ON vs OFF, results
+    # asserted bitwise identical (the plane is purely observational)
+    r13_on, r13_off, ovh13, w13, slo13 = run_slo_overhead(
+        N_EVENTS // 4)
+    configs["13_slo_overhead"] = {
+        "rate": round(r13_on, 1), "rate_no_slo": round(r13_off, 1),
+        "windows": w13,
+        "overhead_frac": round(ovh13, 4),
+        **slo13}
     for name, c in configs.items():
         n_out = c.get("windows", c.get("records", 0))
         print(f"[bench] {name}: {c['rate']:,.0f} tuples/s "

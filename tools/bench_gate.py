@@ -153,6 +153,15 @@ def measure() -> tuple:
     lats["12_distributed_shuffle"] = (
         {"p50_ms": d12["latency_p50_ms"], "p99_ms": d12["latency_p99_ms"]}
         if d12.get("latency_p99_ms") is not None else None)
+    # mission-control smoke (docs/OBSERVABILITY.md "SLO plane" / "Live
+    # cluster view"): the lane with declared objectives + a live
+    # StatsPusher must stay within the cliff threshold;
+    # run_slo_overhead itself asserts identical results and that the
+    # Slo block reached the live merged view
+    r13_on, r13_off, _ovh13, _w13, _slo13 = \
+        bench.run_slo_overhead(N_SMALL)
+    out["13_slo_feed"] = round(r13_on, 1)
+    out["13_no_slo_feed"] = round(r13_off, 1)
     return out, {k: v for k, v in lats.items() if v}
 
 

@@ -316,6 +316,16 @@ class RuntimeConfig:
     # idempotent sink contract (SinkBuilder.with_exactly_once).  None
     # (the default) keeps the pre-durability hot path untouched.
     durability: Any = None
+    # -- SLO plane (slo/; docs/OBSERVABILITY.md "SLO plane") ------------
+    # slo.SloConfig declaring this graph's objectives (e2e p99 budget,
+    # throughput floor, frontier-lag ceiling).  Evaluated continuously
+    # on the diagnosis tick with multi-window error-budget burn-rate
+    # accounting: breaches open slo_breach/slo_recovered flight
+    # episodes, surface as the Slo stats block, windflow_slo_* metrics
+    # and a worst-news-first doctor verdict line.  None (the default)
+    # keeps the plane off; PipeGraph.with_slo(...) is the builder-style
+    # way to set it.
+    slo: Any = None
     # -- distributed runtime plane (distributed/; docs/DISTRIBUTED.md) --
     # distributed.DistributedSpec partitioning this graph across worker
     # processes: PipeGraph.start prunes to the worker's own partition

@@ -59,6 +59,13 @@ def trace_breakdown(rec: dict,
     per-class / per-operator milliseconds.  Returns None for records
     with no usable span."""
     try:
+        if rec.get("partial"):
+            # producer-side fragment of a trace that crossed a wire
+            # edge: its span never closed at a sink HERE, so folding
+            # it would double-charge the hops the consumer-side record
+            # (same trace id) already accounts for.  The merge stitches
+            # fragments back into the closed record instead.
+            return None
         e2e = float(rec.get("e2e_ms") or 0.0)
         raw_hops = rec.get("hops") or []
     except AttributeError:

@@ -29,6 +29,8 @@ SERIES = (
     "queue_depth",         # tuples parked across all inbound channels
     "credit_wait_s",       # cumulative source credit-wait
     "mem_kb",              # process RSS
+    "pool_kb",             # ColumnPool arena bytes held (KiB)
+    "pool_buffers",        # ColumnPool buffers held
 )
 
 

@@ -63,6 +63,12 @@ class DiagnosisPlane:
         self.attribution = AttributionAccumulator()
         self.monitor = RegressionMonitor(k=cfg.anomaly_band_k,
                                          warmup=cfg.anomaly_warmup)
+        # SLO plane (slo/; docs/OBSERVABILITY.md "SLO plane"): the
+        # burn-rate tracker rides this tick -- no thread of its own
+        self.slo = None
+        if getattr(cfg, "slo", None) is not None:
+            from ..slo import SloTracker
+            self.slo = SloTracker(cfg.slo)
         self.edges = operator_edges(graph)
         self.ticks = 0
         self._lock = threading.Lock()
@@ -196,6 +202,10 @@ class DiagnosisPlane:
                 / (now - self._last_t)
         self._last_t = now
         self._last_sink_inputs = sink_inputs
+        # ColumnPool arena occupancy: memory-pressure evidence next to
+        # the process RSS (docs/OBSERVABILITY.md "SLO plane")
+        pool = getattr(g, "buffer_pool", None)
+        ps = pool.stats() if pool is not None else None
         return {
             # results/s: sink items (one TupleBatch counts once), the
             # dashboard result-rate unit -- NOT tuples/s on the batch
@@ -207,6 +217,8 @@ class DiagnosisPlane:
             "queue_depth": depth,
             "credit_wait_s": round(wait, 3),
             "mem_kb": get_mem_usage_kb(),
+            "pool_kb": (ps["bytes"] // 1024) if ps else 0,
+            "pool_buffers": ps["buffers"] if ps else 0,
         }
 
     def _tick(self, now: float) -> None:
@@ -223,6 +235,21 @@ class DiagnosisPlane:
             if ev is not None:
                 kind = ev.pop("event")
                 g.flight.record(kind, **ev)
+        # SLO plane: judge this gauge row against the declared
+        # objectives and advance the burn-rate windows; breach /
+        # recovery episodes land in the flight ring
+        if self.slo is not None:
+            ev = self.slo.update(wall, gauges)
+            if ev is not None:
+                kind = ev.pop("event")
+                g.flight.record(kind, **ev)
+            g.stats.set_slo(self.slo.block())
+        pool = getattr(g, "buffer_pool", None)
+        g.stats.set_pool({
+            "Buffers": gauges["pool_buffers"],
+            "Bytes": gauges["pool_kb"] * 1024,
+            "Hits": pool.hits, "Misses": pool.misses,
+        } if pool is not None else None)
         cap = g.config.queue_capacity
         for row in rows:
             name = row["Operator_name"]

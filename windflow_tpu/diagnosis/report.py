@@ -64,7 +64,28 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
                    "Throughput_rps": last("throughput_rps"),
                    "E2e_p99_us": last("e2e_p99_us"),
                    "Frontier_lag_ms": last("frontier_lag_ms"),
-                   "Queue_depth": last("queue_depth")}
+                   "Queue_depth": last("queue_depth"),
+                   # memory-pressure evidence (SLO plane satellite):
+                   # process RSS + ColumnPool arena occupancy
+                   "Mem_kb": last("mem_kb"),
+                   "Pool_kb": last("pool_kb")}
+    slo_blk = stats.get("Slo")
+    slo = None
+    if slo_blk:
+        slo = {
+            "Objectives": slo_blk.get("Objectives"),
+            "Target": slo_blk.get("Target"),
+            "Breached": bool(slo_blk.get("Breached")),
+            "Breaches_total": int(slo_blk.get("Breaches_total", 0) or 0),
+            "Burn_rate_fast": float(slo_blk.get("Burn_rate_fast", 0)
+                                    or 0.0),
+            "Burn_rate_slow": float(slo_blk.get("Burn_rate_slow", 0)
+                                    or 0.0),
+            "Budget_burned": float(slo_blk.get("Budget_burned", 0)
+                                   or 0.0),
+            "Violating": list(slo_blk.get("Violating") or ()),
+            "Values": dict(slo_blk.get("Values") or {}),
+        }
     failures = [e for e in flight
                 if e.get("kind") in ("node_failure", "stall")]
     dur = stats.get("Durability")
@@ -87,6 +108,7 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
         "Attribution": attribution,
         "Anomalies": anomalies,
         "Anomalies_total": diag.get("Anomalies_total", len(anomalies)),
+        "Slo": slo,
         "Conservation": conservation,
         "Durability": durability,
         "Hot_keys": hot,
@@ -107,6 +129,14 @@ def _verdict(report: dict) -> str:
     cons = report["Conservation"]
     if cons and cons["Violations"]:
         parts.append(f"{cons['Violations']} conservation violation(s)")
+    slo = report.get("Slo")
+    if slo and slo["Breached"]:
+        b = slo["Budget_burned"] * 100
+        parts.append("SLO VIOLATED: "
+                     + _slo_detail(slo, report.get("History"))
+                     + ", budget "
+                     + (f"{b:.0f}%" if b >= 1 else "<1%")
+                     + " burned")
     dur = report.get("Durability")
     if dur and dur["Stalled"]:
         # stalled epochs: barriers stopped reaching the sinks (a
@@ -129,6 +159,46 @@ def _verdict(report: dict) -> str:
     if cons and not cons["Violations"] and cons["Balanced"]:
         parts.append("ledger balanced")
     return "; ".join(parts) if parts else "no diagnosis signals"
+
+
+def _slo_detail(slo: dict, history: Optional[dict]) -> str:
+    """Human phrasing of the violating objectives, citing the last
+    judged gauge value (the Slo block's ``Values``; the History row is
+    the fallback for older dumps)."""
+    obj = slo.get("Objectives") or {}
+    vals = slo.get("Values") or {}
+    hist = history or {}
+
+    def ms(v):
+        return f"{float(v):g} ms"
+
+    out = []
+    for name in slo.get("Violating") or ():
+        if name == "e2e_p99":
+            cur = vals.get("e2e_p99_ms") or (
+                (hist.get("E2e_p99_us") or 0) / 1e3 or None)
+            out.append("e2e p99 "
+                       + (ms(cur) + " > " if cur else "over ")
+                       + ms(obj.get("p99_ms", 0)))
+        elif name == "throughput":
+            cur = vals.get("throughput_rps",
+                           hist.get("Throughput_rps"))
+            out.append("throughput "
+                       + (f"{float(cur):g}" + " < " if cur is not None
+                          else "under ")
+                       + f"{float(obj.get('min_throughput_rps', 0)):g}"
+                       " rps")
+        elif name == "frontier_lag":
+            cur = vals.get("frontier_lag_ms",
+                           hist.get("Frontier_lag_ms"))
+            out.append("frontier lag "
+                       + (ms(cur) + " > " if cur else "over ")
+                       + ms(float(obj.get("max_frontier_lag_s", 0))
+                            * 1e3))
+        else:
+            out.append(name)
+    return ", ".join(out) if out else "error budget burning " \
+        f"{slo.get('Burn_rate_fast', 0):g}x"
 
 
 def _pct(v) -> str:
@@ -188,6 +258,17 @@ def render_text(report: dict) -> str:
         for a in anoms:
             out.append(f"  {a.get('series')}: {a.get('value')} outside "
                        f"{a.get('band')}")
+    slo = report.get("Slo")
+    if slo:
+        out.append("")
+        obj = ", ".join(f"{k}={v:g}" for k, v in
+                        (slo.get("Objectives") or {}).items())
+        out.append(f"slo [{obj}] target={slo.get('Target')}: "
+                   + ("BREACHED" if slo.get("Breached") else "ok")
+                   + f"  burn fast={slo.get('Burn_rate_fast', 0):g}x "
+                   f"slow={slo.get('Burn_rate_slow', 0):g}x  "
+                   f"budget {slo.get('Budget_burned', 0) * 100:.0f}% "
+                   f"burned  episodes={slo.get('Breaches_total', 0)}")
     cons = report.get("Conservation")
     if cons:
         out.append("")
@@ -213,7 +294,10 @@ def render_text(report: dict) -> str:
         out.append(f"history: {hist['Ticks']} ticks, last sink rate "
                    f"{hist['Throughput_rps']} results/s, e2e p99 "
                    f"{hist['E2e_p99_us']} us, frontier lag "
-                   f"{hist['Frontier_lag_ms']} ms")
+                   f"{hist['Frontier_lag_ms']} ms"
+                   + (f", rss {hist['Mem_kb']:.0f} KiB"
+                      f" (pool {hist.get('Pool_kb') or 0:.0f} KiB)"
+                      if hist.get("Mem_kb") else ""))
     tail = report.get("Flight_tail") or []
     if tail:
         out.append("")

@@ -55,6 +55,11 @@ class DistributedSpec:
     # edge counts as lost (graph cancels)
     reconnect_grace_s: float = 2.0
     connect_timeout_s: float = 15.0
+    # live cluster view (observe.py): the coordinator's ClusterObserver
+    # ingest endpoint -- when set, the wiring attaches a StatsPusher
+    # that pushes stats + flight deltas every push_interval_s
+    observe_endpoint: Optional[Tuple[str, int]] = None
+    push_interval_s: float = 0.5
     extra: dict = field(default_factory=dict)
 
 
@@ -192,11 +197,14 @@ def worker_main(spec_doc: dict) -> int:
                  if spec_doc.get("config") else None)
     cfg = config_fn(wid) if config_fn is not None else RuntimeConfig()
     dcfg = _worker_durability(cfg, wid)
+    observe = spec_doc.get("observe")
     cfg.distributed = DistributedSpec(
         worker_id=wid,
         n_workers=int(spec_doc["n_workers"]),
         endpoints=[tuple(e) for e in spec_doc["endpoints"]],
         assignment=spec_doc.get("assignment") or None,
+        observe_endpoint=(observe[0], int(observe[1]))
+        if observe else None,
         **(spec_doc.get("wire") or {}))
     from ..graph.pipegraph import PipeGraph
     g = PipeGraph(spec_doc.get("graph_name", "dist"), config=cfg)
@@ -257,20 +265,38 @@ def run_distributed(build: Callable, n_workers: int = 2, *,
                     workdir: Optional[str] = None,
                     max_restarts: int = 0,
                     timeout_s: float = 300.0,
-                    wire: Optional[dict] = None) -> dict:
+                    wire: Optional[dict] = None,
+                    observe: bool = True) -> dict:
     """Run ``build`` as one PipeGraph across ``n_workers`` processes.
 
     Returns a report dict: per-worker stats paths, the merged one-graph
     view (:func:`~.observe.merge_stats`), attempts taken, and per-worker
     exit codes.  Raises :class:`WorkerFailure` when workers still fail
     past ``max_restarts``.
+
+    With ``observe`` (the default) the coordinator also runs a live
+    :class:`~.observe.ClusterObserver`: workers push stats + flight
+    deltas to it mid-run, the continuously-merged view (and its doctor
+    report) is served at ``GET /cluster``, and the endpoint is written
+    to ``<workdir>/observer.json`` so tools -- notably ``python -m
+    windflow_tpu.doctor --watch <url>`` -- can find it while the run
+    is still going.  The observer survives restart attempts, so the
+    live view spans a kill-restart cycle.
     """
-    from .observe import merge_stats
+    from .observe import ClusterObserver, merge_stats
     build_ref = _callable_ref(build)
     config_ref = _callable_ref(config_fn) if config_fn else None
     workdir = workdir or os.path.join("log", f"dist_{graph_name}")
     os.makedirs(workdir, exist_ok=True)
     dcfg = config_fn(0).durability if config_fn else None
+    observer = None
+    if observe:
+        observer = ClusterObserver()
+        observer.start()
+        observer.serve_http()
+        with open(os.path.join(workdir, "observer.json"), "w") as f:
+            json.dump({"http": observer.http_url,
+                       "ingest": [observer.host, observer.port]}, f)
     attempts = 0
     history: List[Dict[int, int]] = []
     while True:
@@ -293,6 +319,8 @@ def run_distributed(build: Callable, n_workers: int = 2, *,
                 "restore_epoch": restore,
                 "attempt": attempts,
                 "wire": wire or {},
+                "observe": ([observer.host, observer.port]
+                            if observer is not None else None),
             }
             stats_paths[w] = spec_doc["stats_path"]
             logs[w] = os.path.join(workdir, f"worker_{w}.log")
@@ -328,6 +356,8 @@ def run_distributed(build: Callable, n_workers: int = 2, *,
                     if rc is not None:
                         codes[w] = rc
                 if _time.monotonic() > deadline:
+                    if observer is not None:
+                        observer.stop()
                     raise WorkerFailure(
                         f"distributed run timed out after {timeout_s}s "
                         f"(exited: {codes})", codes, logs)
@@ -373,12 +403,23 @@ def run_distributed(build: Callable, n_workers: int = 2, *,
                         stats.append(json.load(f))
                 except (OSError, ValueError):
                     stats.append(None)
+            live_merged = None
+            observer_info = None
+            if observer is not None:
+                # the live view's final fold (what --watch last saw),
+                # next to the authoritative file-based merge below
+                live_merged = observer.merged()
+                observer_info = {"url": observer.http_url,
+                                 "pushes": observer.pushes}
+                observer.stop()
             return {
                 "attempts": attempts + 1,
                 "exit_codes": history,
                 "stats_paths": [stats_paths[w] for w in range(n_workers)],
                 "worker_stats": stats,
                 "merged": merge_stats([s for s in stats if s]),
+                "live_merged": live_merged,
+                "observer": observer_info,
                 "logs": [logs[w] for w in range(n_workers)],
             }
         attempts += 1
@@ -391,6 +432,8 @@ def run_distributed(build: Callable, n_workers: int = 2, *,
                 except OSError:
                     tails[w] = ""
             killed = [w for w, rc in codes.items() if rc == KILL_EXIT]
+            if observer is not None:
+                observer.stop()
             raise WorkerFailure(
                 f"distributed run failed after {attempts} attempt(s): "
                 f"exit codes {codes}"

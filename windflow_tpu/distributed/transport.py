@@ -64,6 +64,33 @@ def _recv_some(sock) -> Optional[bytes]:
         return None
 
 
+class _PartialTraceView:
+    """A live producer-side view of a trace that left this worker over
+    a wire edge.  Serializes like a closed trace record but flagged
+    ``partial``: attribution skips it (its span never reached a sink
+    here), while the cross-worker merge
+    (distributed/observe.stitch_traces) joins it by trace id into the
+    consumer-side record that closed the same trace.  The view wraps
+    the LIVE context, so hops stamped moments after the frame header
+    was snapshotted -- fused upstream segments unwind outward through
+    the send -- still make the producer's record and therefore the
+    stitched cluster-wide one."""
+
+    __slots__ = ("ctx", "edge")
+
+    is_partial = True
+
+    def __init__(self, ctx, edge: str):
+        self.ctx = ctx
+        self.edge = edge
+
+    def to_dict(self, t_end: float) -> dict:
+        d = self.ctx.to_dict(t_end)
+        d["partial"] = True
+        d["wire_edge"] = self.edge
+        return d
+
+
 class RemoteEdgeSender:
     """Producer-side half of one shuffle edge: a channel-duck-typed
     object the owning worker's outlets deliver into.
@@ -108,6 +135,11 @@ class RemoteEdgeSender:
         self.puts = 0
         self.gets = 0
         self.high_watermark = 0
+        # running tuple sum of the replay buffer (gauge-grade read by
+        # block(); maintained under the lock by _ship/_apply_ack so
+        # the stats path never takes the send lock -- a reconnecting
+        # producer may hold it for seconds)
+        self.unacked_tuples = 0
         self.tuples_sent = 0
         self.frames_sent = 0
         self.barriers_sent = 0
@@ -146,10 +178,21 @@ class RemoteEdgeSender:
         else:
             cost = 1
         self.gate.acquire(cost)
+        ctx = getattr(item, "trace", None)
         kind, payload, cost = wire.encode_item(
             item, getattr(self.graph, "buffer_pool", None))
         self._ship(kind, producer_id, payload, cost,
                    barrier=item if kind == wire.MSG_BARRIER else None)
+        if ctx is not None and getattr(ctx, "trace_id", None) \
+                and kind in (wire.MSG_DATA, wire.MSG_RECORD):
+            # producer-side PARTIAL trace record: the trace continues
+            # on the consumer worker, but this worker's share of it --
+            # including hops that land after the frame header snapshot
+            # -- must survive into the merged cluster view (separate
+            # bounded ring: never evicts locally-closed records)
+            self.graph.stats.add_trace_partial(
+                (_PartialTraceView(ctx, self.edge),
+                 _time.perf_counter()))
         if self.runtime is not None and kind != wire.MSG_BARRIER:
             self.runtime.count_transport(cost)
 
@@ -214,7 +257,13 @@ class RemoteEdgeSender:
             # mirror count neither (close() is not a put), so the
             # ledger's channel book must not see them either
             counted = kind not in (wire.MSG_STATS, wire.MSG_EOS)
-            self._unacked.append((seq, frame, counted, cost))
+            # data_cost: TUPLES in this frame (what tuples_sent counts)
+            # -- the live merge bounds a delivery shortfall by the
+            # replay buffer's tuple sum, so the unit must match
+            data_cost = cost if kind in (wire.MSG_DATA,
+                                         wire.MSG_RECORD) else 0
+            self._unacked.append((seq, frame, counted, cost, data_cost))
+            self.unacked_tuples += data_cost
             if len(self._unacked) > self.high_watermark:
                 self.high_watermark = len(self._unacked)
             if counted:
@@ -359,7 +408,7 @@ class RemoteEdgeSender:
         # before the drop can at worst over-credit harmlessly, never
         # leak the window smaller on every reconnect)
         self._apply_ack(0, acked, release_popped=True)
-        for _seq, frame, _counted, _cost in list(self._unacked):
+        for _seq, frame, _counted, _cost, _dc in list(self._unacked):
             s.sendall(frame)
 
     def _start_reader(self) -> None:
@@ -419,10 +468,12 @@ class RemoteEdgeSender:
             popped = 0
             popped_cost = 0
             while self._unacked and self._unacked[0][0] <= acked_seq:
-                _seq, _frame, counted, cost = self._unacked.popleft()
+                _seq, _frame, counted, cost, data_cost = \
+                    self._unacked.popleft()
                 if counted:
                     popped += 1
                 popped_cost += cost
+                self.unacked_tuples -= data_cost
             self.gets += popped
         if release_popped and popped_cost:
             self.gate.release(popped_cost)
@@ -455,13 +506,19 @@ class RemoteEdgeSender:
         return True
 
     def block(self) -> dict:
-        """One row of the stats-JSON ``Wire.out`` table."""
+        """One row of the stats-JSON ``Wire.out`` table.  Deliberately
+        LOCK-FREE (gauge-grade reads): a producer thread may hold the
+        send lock for seconds inside a reconnect loop, and the stats /
+        live-push path must keep reporting exactly then."""
         return {
             "edge": self.edge, "to": (self.host, self.port),
             "tuples": self.tuples_sent, "frames": self.frames_sent,
             "barriers": self.barriers_sent,
             "dropped_frames": self.frames_dropped,
             "unacked": len(self._unacked),
+            # tuple sum of the replay buffer: the live merge's
+            # in-flight bound (frames != tuples on the batch plane)
+            "unacked_tuples": max(0, self.unacked_tuples),
             "reconnects": self.reconnects,
             "credit_waits": self.gate.credit_waits,
             "credit_wait_s": round(self.gate.wait_time_s, 4),

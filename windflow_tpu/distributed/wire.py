@@ -239,6 +239,7 @@ def _trace_header(item) -> bytes:
     now = _time.perf_counter()
     doc = {
         "src": ctx.src,
+        "id": getattr(ctx, "trace_id", None),
         "age_s": round(now - ctx.t0, 9),
         "last_s": round(ctx.last - ctx.t0, 9),
         "sent_unix": _time.time(),
@@ -279,7 +280,8 @@ def rebuild_trace(doc: Optional[dict], edge: str,
     age = float(doc.get("age_s") or 0.0)
     last = float(doc.get("last_s") or 0.0)
     ctx = TraceContext(str(doc.get("src") or "?"),
-                       arrival - age - wire_s)
+                       arrival - age - wire_s,
+                       trace_id=doc.get("id"))
     for hop in doc.get("hops") or ():
         try:
             name, a, d = hop[0], float(hop[1]), float(hop[2])

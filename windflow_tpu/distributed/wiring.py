@@ -50,6 +50,9 @@ class DistRuntime:
         self.senders = senders
         self.server = server
         self.kill_at = kill_at
+        # live cluster view (observe.py): the StatsPusher feeding the
+        # coordinator's ClusterObserver, when the spec names one
+        self.pusher = None
         self._lock = threading.Lock()
         self.transport_tuples = 0
 
@@ -83,12 +86,24 @@ class DistRuntime:
 
     def stop(self, clean: bool = True) -> None:
         if clean:
+            # generous but SHARED: a clean end legitimately waits out
+            # a slow remote consumer draining the credit window (the
+            # flush loop still exits early on poison/CANCEL), but one
+            # deadline covers every sender -- K wedged edges must not
+            # stack K x 60s past run_distributed's own timeout.  A
+            # timeout surfaces as residual_items at the final check.
+            import time as _t
+            deadline = _t.monotonic() + 60.0
             for s in self.senders.values():
-                s.flush(timeout=5.0)
+                s.flush(timeout=max(0.0, deadline - _t.monotonic()))
         for s in self.senders.values():
             s._close_sock()
         if self.server is not None:
             self.server.stop()
+        if self.pusher is not None:
+            # LAST: its stop() pushes one final frame, so the live
+            # merged view carries the settled wire books
+            self.pusher.stop()
 
 
 def distribute_graph(graph) -> DistRuntime:
@@ -213,6 +228,15 @@ def distribute_graph(graph) -> DistRuntime:
     graph._wire_topology = sorted([a, b, "wire"]
                                   for a, b in wire_edges)
     graph._dist = runtime
+    # live cluster view (observe.py): push stats + flight deltas to
+    # the coordinator's ClusterObserver mid-run, so the merged doctor
+    # verdict is nameable without touching any stats file
+    obs = getattr(spec, "observe_endpoint", None)
+    if obs:
+        from .observe import attach_pusher
+        runtime.pusher = attach_pusher(
+            graph, obs[0], int(obs[1]),
+            float(getattr(spec, "push_interval_s", 0.5)))
     graph.flight.record(
         "distribute", worker=me, nodes=len(nodes) - len(removed),
         pruned=len(removed), wire_out=len(senders), wire_in=len(inbound))

@@ -5,10 +5,17 @@
     python -m windflow_tpu.doctor log/
     python -m windflow_tpu.doctor log/1234_app_stats.json
     python -m windflow_tpu.doctor log/ --json
+    python -m windflow_tpu.doctor --watch http://127.0.0.1:41234
 
 * **URL** -- a live dashboard HTTP server (monitoring/dashboard.py):
   fetches ``/apps`` and renders one report per registered app (the
   server-side ``/explain`` endpoint returns the same reports as JSON).
+* **--watch URL** -- live CLUSTER mode (docs/OBSERVABILITY.md "Live
+  cluster view"): polls the ``/cluster`` endpoint (the coordinator's
+  ClusterObserver, or a dashboard HTTP server) every ``--interval``
+  seconds and refreshes the MERGED doctor verdict in place -- a
+  bottleneck on a remote worker is named mid-run with zero stats
+  files read.  ``--once`` renders a single refresh (CI smoke).
 * **directory** -- an offline dump dir: picks the newest stats-JSON
   dump (the monitor's ``*_stats.json`` snapshot fallback or
   ``PipeGraph._dump_logs``'s ``<pid>_<graph>.json``) and, when a
@@ -135,6 +142,69 @@ def fetch_reports(url: str) -> List[Tuple[str, dict, Optional[list]]]:
     return out
 
 
+def fetch_cluster(url: str) -> Tuple[dict, dict]:
+    """Pull one ``/cluster`` snapshot: ``(merged_stats, meta)``.  The
+    report is re-derived locally from the merged stats (the tolerant-
+    loading contract applies to the live endpoint too)."""
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=5) as r:
+        doc = json.loads(r.read().decode())
+    merged = doc.get("merged") or {}
+    meta = {"workers": doc.get("workers"), "pushes": doc.get("pushes"),
+            "now": doc.get("now")}
+    return merged, meta
+
+
+def _watch_url(target: str) -> str:
+    base = target if target.startswith(("http://", "https://")) \
+        else "http://" + target
+    base = base.rstrip("/")
+    return base if base.endswith("/cluster") else base + "/cluster"
+
+
+def watch(target: str, interval_s: float = 2.0, once: bool = False,
+          as_json: bool = False) -> int:
+    """The ``--watch`` loop: poll the merged cluster view and refresh
+    the verdict in place (clears the screen on a tty; plain appends
+    otherwise, so piping to a file keeps every refresh)."""
+    import time
+    url = _watch_url(target)
+    seen_any = False
+    while True:
+        try:
+            merged, meta = fetch_cluster(url)
+        except (OSError, ValueError) as e:
+            if once and not seen_any:
+                print(f"doctor: cannot reach {url}: {e}",
+                      file=sys.stderr)
+                return 2
+            merged, meta = None, None
+        out: List[str] = []
+        if merged:
+            seen_any = True
+            rep = build_report(merged, merged.get("Flight"))
+            rep["Source"] = url
+            if as_json:
+                out.append(json.dumps(rep, indent=1))
+            else:
+                n_workers = len((meta or {}).get("workers") or {})
+                out.append(f"-- live cluster view {url} "
+                           f"({n_workers} worker(s), "
+                           f"{(meta or {}).get('pushes', 0)} pushes) --")
+                out.append(render_text(rep))
+        else:
+            out.append(f"-- waiting for worker pushes at {url} --")
+        if sys.stdout.isatty() and not as_json:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+        print("\n".join(out), flush=True)
+        if once:
+            return 0
+        try:
+            time.sleep(max(0.1, interval_s))
+        except KeyboardInterrupt:
+            return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m windflow_tpu.doctor",
@@ -151,7 +221,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="merge multiple per-worker stats dumps of one "
                          "distributed run into ONE graph view "
                          "(distributed/observe.py) before reporting")
+    ap.add_argument("--watch", action="store_true",
+                    help="live cluster mode: poll the target's "
+                         "/cluster endpoint and refresh the merged "
+                         "verdict in place")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between --watch refreshes")
+    ap.add_argument("--once", action="store_true",
+                    help="with --watch: render a single refresh and "
+                         "exit (smoke tests)")
     args = ap.parse_args(argv)
+    if args.watch:
+        if len(args.targets) != 1:
+            print("doctor: --watch takes exactly one URL",
+                  file=sys.stderr)
+            return 2
+        return watch(args.targets[0], args.interval, args.once,
+                     args.json)
     try:
         urls = [t for t in args.targets
                 if t.startswith(("http://", "https://"))]

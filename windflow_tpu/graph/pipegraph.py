@@ -380,6 +380,14 @@ class PipeGraph:
             from ..diagnosis import DiagnosisPlane
             self.diagnosis = DiagnosisPlane(self)
             self.stats.set_topology(self.diagnosis.edges)
+        elif self.config.slo is not None:
+            # the SLO plane has no tick of its own -- it rides the
+            # diagnosis tick; a declared objective that silently never
+            # evaluates would be worse than a loud refusal
+            raise RuntimeError(
+                "RuntimeConfig.slo needs the diagnosis plane: SLO "
+                "burn rates are evaluated on the diagnosis tick "
+                "(leave RuntimeConfig.diagnosis at its default True)")
         # durability plane (durability/; docs/RESILIENCE.md): the epoch
         # coordinator + per-node barrier aligners/injectors.  AFTER the
         # audit books (barriers ride Outlet.send_to, so per-edge
@@ -714,6 +722,25 @@ class PipeGraph:
         if event is not None:
             self.flight.record("rescale", **event.to_dict())
         return event
+
+    # -- SLO plane (slo/; docs/OBSERVABILITY.md "SLO plane") ------------
+    def with_slo(self, p99_ms: Optional[float] = None,
+                 min_throughput_rps: Optional[float] = None,
+                 max_frontier_lag_s: Optional[float] = None,
+                 **kw) -> "PipeGraph":
+        """Declare this graph's service-level objectives (chainable,
+        before ``start``).  Shorthand for setting
+        ``RuntimeConfig.slo = SloConfig(...)``; extra keywords
+        (``target``, ``window_scale``, ``fast_burn``...) pass through.
+        The SLO is evaluated on the diagnosis tick, so it needs
+        ``RuntimeConfig.diagnosis`` (the default) to stay on."""
+        if self._started:
+            raise RuntimeError("with_slo() must be called before start()")
+        from ..slo import SloConfig
+        self.config.slo = SloConfig(
+            p99_ms=p99_ms, min_throughput_rps=min_throughput_rps,
+            max_frontier_lag_s=max_frontier_lag_s, **kw)
+        return self
 
     def refresh_gauges(self) -> None:
         """Update the per-replica gauge fields of the stats records

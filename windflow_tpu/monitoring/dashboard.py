@@ -136,6 +136,38 @@ def serve_http(dash: DashboardServer, port: int = 20208):
                     for aid, app in snap.items()
                     if isinstance(app, dict)}).encode()
                 ctype = "application/json"
+            elif path == "/cluster":
+                # live cluster view (docs/OBSERVABILITY.md): fold every
+                # registered app's latest report with merge_stats --
+                # the workers of one distributed run each register as
+                # an app carrying a Worker id, so the fold is the same
+                # one-graph view the coordinator's ClusterObserver
+                # serves (and `doctor --watch` polls either endpoint)
+                from ..diagnosis.report import build_report
+                from ..distributed.observe import merge_stats
+                snap = dash.snapshot()
+                reports = []
+                for aid, app in sorted(snap.items(),
+                                       key=lambda kv: str(kv[0])):
+                    if not isinstance(app, dict) or not app.get("report"):
+                        continue
+                    rep = dict(app["report"])
+                    if rep.get("Worker") is None:
+                        # single-process apps carry no worker id; give
+                        # each a distinct pseudo-id so the merge's
+                        # (worker, seq) flight dedup cannot collide
+                        # two unrelated graphs' per-process seqs
+                        rep["Worker"] = f"app{aid}"
+                    reports.append(rep)
+                # live=True: these are mid-run snapshots captured at
+                # different instants -- merge-time wire imbalances are
+                # skew, not loss (online detectors own live loss)
+                merged = merge_stats(reports, live=True)
+                rep = build_report(merged, merged.get("Flight")) \
+                    if merged else None
+                body = json.dumps({"merged": merged,
+                                   "report": rep}).encode()
+                ctype = "application/json"
             elif path == "/explain":
                 from ..diagnosis.report import build_report
                 snap = dash.snapshot()

@@ -26,10 +26,12 @@ from ..telemetry.histogram import LogHistogram
 # 4 = adds the optional Durability block (epoch coordinator gauges).
 # 5 = adds the optional Worker id + Wire block (distributed runtime's
 # per-edge wire delivery books; distributed/observe.py merges them).
+# 6 = adds the optional Slo block (burn-rate tracker gauges,
+# slo/plane.py) and the Pool block (ColumnPool arena occupancy).
 # Readers (doctor CLI, dashboard /explain, tests) must tolerate MISSING
 # blocks rather than dispatch on this number: older dumps carry no
 # version field at all, and every block is optional by contract.
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 
 
 @dataclass
@@ -223,6 +225,12 @@ class GraphStats:
         self.histograms = False
         self.e2e_extra: Optional[LogHistogram] = None
         self.trace_records: deque = deque(maxlen=16)
+        # distributed plane: producer-side PARTIAL records of traces
+        # that left this worker over a wire edge (the consumer closes
+        # them; the merge stitches by id).  A separate ring so a busy
+        # outbound edge can never evict this worker's own closed
+        # records from the bounded ring above.
+        self.trace_partials: deque = deque(maxlen=16)
         # audit plane (audit/; docs/OBSERVABILITY.md): the latest
         # Conservation and Skew blocks, published by the GraphAuditor
         # after every pass (and after the wait_end final check)
@@ -243,6 +251,12 @@ class GraphStats:
         # the latest per-edge wire delivery books, refreshed per report
         self.worker: Optional[int] = None
         self.wire: Optional[dict] = None
+        # SLO plane (slo/; docs/OBSERVABILITY.md "SLO plane"): the
+        # burn-rate tracker's latest gauges, published per diagnosis
+        # tick; and the ColumnPool arena occupancy gauges (memory-
+        # pressure evidence for the SLO/doctor surfaces)
+        self.slo: Optional[dict] = None
+        self.pool: Optional[dict] = None
 
     def register(self, operator_name: str, replica_id: str) -> StatsRecord:
         rec = StatsRecord(operator_name, replica_id)
@@ -270,6 +284,12 @@ class GraphStats:
         upstream segments unwind outward through the closing sink --
         still make the record."""
         self.trace_records.append(rec)
+
+    def add_trace_partial(self, rec) -> None:
+        """Append one producer-side partial trace view (same live
+        ``(view, t)`` contract as :meth:`add_trace_record`, separate
+        bounded ring)."""
+        self.trace_partials.append(rec)
 
     def set_parallelism(self, operator_name: str, n: int) -> None:
         with self.lock:
@@ -319,6 +339,18 @@ class GraphStats:
         with self.lock:
             self.wire = block
 
+    def set_slo(self, block: dict) -> None:
+        """Publish the SLO tracker's latest burn-rate gauges
+        (slo/plane.py, once per diagnosis tick)."""
+        with self.lock:
+            self.slo = block
+
+    def set_pool(self, block: Optional[dict]) -> None:
+        """Publish the ColumnPool arena occupancy gauges
+        (diagnosis/plane.py, once per tick)."""
+        with self.lock:
+            self.pool = block
+
     def to_json(self, dropped_tuples: int = 0,
                 dead_letter_tuples: int = 0,
                 flight_events: Optional[List[dict]] = None) -> str:
@@ -358,6 +390,8 @@ class GraphStats:
             durability = self.durability
             worker = self.worker
             wire = self.wire
+            slo = self.slo
+            pool = self.pool
             latency_e2e = None
             trace_records: List[dict] = []
             if self.histograms:
@@ -372,6 +406,11 @@ class GraphStats:
                 # when a sink thread closes a trace mid-report
                 trace_records = [ctx.to_dict(t_end)
                                  for ctx, t_end in list(self.trace_records)]
+                # wire-crossing partials ride the same JSON list (the
+                # serialized dicts carry "partial": true; attribution
+                # skips them, the cross-worker merge stitches by id)
+                trace_records += [v.to_dict(t_end) for v, t_end
+                                  in list(self.trace_partials)]
         payload = {
             "PipeGraph_name": self.graph_name,
             # report-shape version (see SCHEMA_VERSION above); loaders
@@ -430,6 +469,13 @@ class GraphStats:
             # N such dumps into one graph view.
             "Worker": worker,
             "Wire": wire,
+            # SLO plane (slo/; docs/OBSERVABILITY.md "SLO plane"):
+            # burn-rate tracker gauges -- windows, fast/slow burn
+            # rates, budget burned, open-breach flag; None with no
+            # declared objectives.  The ColumnPool arena occupancy
+            # rides next to it as memory-pressure evidence.
+            "Slo": slo,
+            "Pool": pool,
             "Memory_usage_KB": get_mem_usage_kb(),
             "Operator_number": len(ops),
             "Operators": ops,

@@ -392,6 +392,18 @@ function render(apps) {
             score ${num(bn.Score).toFixed(2)},
             ${anoms} regression${anoms === 1 ? "" : "s"})</div></div>`;
         })()}
+        ${(() => {  // SLO plane: burn-rate tile (Slo stats block)
+          const s = rep.Slo;
+          if (!s) return "";
+          const bad = !!s.Breached;
+          return `<div class="tile"><div class="v${bad ? " bad" : ""}">
+            ${bad ? "\\u2715 SLO breached" : "\\u2713 in SLO"}</div>
+            <div class="k">burn ${num(s.Burn_rate_fast).toFixed(1)}x /
+            ${num(s.Burn_rate_slow).toFixed(1)}x, budget
+            ${(num(s.Budget_burned) * 100).toFixed(0)}% burned
+            (${num(s.Breaches_total)} episode${
+              num(s.Breaches_total) === 1 ? "" : "s"})</div></div>`;
+        })()}
       </div>
       ${a.diagram.trim().startsWith("<svg") ? svgImg(a.diagram) : topoSvg(parseDot(a.diagram))}
       <div class="spark-wrap">${sparkline(id, hist[id])}</div>

@@ -234,6 +234,57 @@ def render_openmetrics(apps: dict) -> str:
                 f"windflow_bottleneck_score"
                 f"{_labels(**lab, operator=bn['Operator'], verdict=bn.get('Verdict', ''))} "
                 f"{float(bn.get('Score', 0) or 0)}")
+    # SLO plane (slo/; docs/OBSERVABILITY.md "SLO plane"): burn-rate
+    # tracker gauges -- absent entirely with no declared objectives
+    family("windflow_slo_breached", "gauge",
+           "1 while an SLO breach episode is open")
+    for rep, lab in per_graph():
+        slo = rep.get("Slo")
+        if slo:
+            out.append(f"windflow_slo_breached{_labels(**lab)} "
+                       f"{1 if slo.get('Breached') else 0}")
+    family("windflow_slo_burn_rate", "gauge",
+           "error-budget burn rate over the fast/slow window "
+           "(1 = burning exactly at the target rate)")
+    for rep, lab in per_graph():
+        slo = rep.get("Slo")
+        if slo:
+            for win in ("fast", "slow"):
+                out.append(
+                    f"windflow_slo_burn_rate"
+                    f"{_labels(**lab, window=win)} "
+                    f"{float(slo.get(f'Burn_rate_{win}', 0) or 0)}")
+    family("windflow_slo_budget_burned", "gauge",
+           "fraction of the slow window's error budget consumed "
+           "(> 1 = overdrawn)")
+    for rep, lab in per_graph():
+        slo = rep.get("Slo")
+        if slo:
+            out.append(f"windflow_slo_budget_burned{_labels(**lab)} "
+                       f"{float(slo.get('Budget_burned', 0) or 0)}")
+    family("windflow_slo_breaches", "counter",
+           "SLO breach episodes opened since graph start")
+    for rep, lab in per_graph():
+        slo = rep.get("Slo")
+        if slo:
+            out.append(f"windflow_slo_breaches_total{_labels(**lab)} "
+                       f"{int(slo.get('Breaches_total', 0) or 0)}")
+    # ColumnPool arena occupancy (memory-pressure evidence next to
+    # windflow_memory_bytes)
+    family("windflow_pool_bytes", "gauge",
+           "bytes held by the graph's ColumnPool arena")
+    for rep, lab in per_graph():
+        pool = rep.get("Pool")
+        if pool:
+            out.append(f"windflow_pool_bytes{_labels(**lab)} "
+                       f"{int(pool.get('Bytes', 0) or 0)}")
+    family("windflow_pool_buffers", "gauge",
+           "buffers held by the graph's ColumnPool arena")
+    for rep, lab in per_graph():
+        pool = rep.get("Pool")
+        if pool:
+            out.append(f"windflow_pool_buffers{_labels(**lab)} "
+                       f"{int(pool.get('Buffers', 0) or 0)}")
     # durability plane (durability/; docs/RESILIENCE.md): epoch
     # coordinator gauges -- absent entirely when the plane is off
     family("windflow_epoch", "gauge",

@@ -20,24 +20,32 @@ import json
 import os
 import time
 from collections import deque
+from itertools import count
 from typing import List, Optional
 
 
 class FlightRecorder:
     """Bounded structured-event ring.  ``record()`` is safe from any
-    thread; ``capacity <= 0`` disables recording entirely."""
+    thread; ``capacity <= 0`` disables recording entirely.
 
-    __slots__ = ("_ring", "enabled", "dumped_path")
+    Every event carries a per-recorder monotone ``seq``: the live
+    cluster view ships bounded flight *deltas* (events past the last
+    acknowledged seq) and the cross-worker merge dedups overlapping
+    tails by ``(worker, seq)`` (distributed/observe.py)."""
+
+    __slots__ = ("_ring", "enabled", "dumped_path", "_seq")
 
     def __init__(self, capacity: int = 512):
         self.enabled = capacity > 0
         self._ring: deque = deque(maxlen=max(1, capacity))
         self.dumped_path: Optional[str] = None
+        self._seq = count(1)  # itertools.count: GIL-atomic next()
 
     def record(self, kind: str, **fields) -> None:
         if not self.enabled:
             return
-        ev = {"t": round(time.time(), 6), "kind": kind}
+        ev = {"t": round(time.time(), 6), "seq": next(self._seq),
+              "kind": kind}
         ev.update(fields)
         self._ring.append(ev)
 

@@ -38,15 +38,23 @@ MAX_HOPS = 64
 
 
 class TraceContext:
-    """Per-sampled-item trace state: source stamp + per-hop stamps."""
+    """Per-sampled-item trace state: source stamp + per-hop stamps.
 
-    __slots__ = ("src", "t0", "last", "hops")
+    ``trace_id`` names the trace across process boundaries: the
+    sampler stamps ``<source>#<n>`` (deterministic per source
+    replica), the wire codec ships it in the frame header, and the
+    cross-worker merge (distributed/observe.stitch_traces) joins
+    per-worker partial records back into one e2e record by it."""
 
-    def __init__(self, src: str, t0: float):
+    __slots__ = ("src", "t0", "last", "hops", "trace_id")
+
+    def __init__(self, src: str, t0: float,
+                 trace_id: Optional[str] = None):
         self.src = src
         self.t0 = t0
         self.last = t0          # most recent 'done' stamp (residency base)
         self.hops: list = []    # (operator, t_arrive, t_done)
+        self.trace_id = trace_id
 
     def hop(self, name: str, t_in: float, t_done: float) -> None:
         if len(self.hops) < MAX_HOPS:
@@ -55,13 +63,16 @@ class TraceContext:
 
     def to_dict(self, t_end: float) -> dict:
         t0 = self.t0
-        return {
+        d = {
             "src": self.src,
             "e2e_ms": round((t_end - t0) * 1e3, 3),
             "hops": [[name, round((a - t0) * 1e3, 3),
                       round((d - t0) * 1e3, 3)]
                      for name, a, d in self.hops],
         }
+        if self.trace_id is not None:
+            d["id"] = self.trace_id
+        return d
 
 
 def get_trace(item) -> Optional[TraceContext]:
@@ -107,7 +118,9 @@ class TraceSampler:
             # context -- an untraceable item (dict, control marker)
             # landing on the N-th emission defers the sample to the
             # next attachable one instead of silently eating it
-            if attach(item, TraceContext(self.src, _time.perf_counter())):
+            ctx = TraceContext(self.src, _time.perf_counter(),
+                               trace_id=f"{self.src}#{self.started + 1}")
+            if attach(item, ctx):
                 self._n = 0
                 self.started += 1
 
