@@ -1007,6 +1007,119 @@ def run_slo_overhead(n_events):
     return rate_on, rate_off, overhead, w_on, summary
 
 
+def run_multitenant_contention(n_events, n_tenants=3):
+    """Config #14: the multi-tenant serving plane (docs/SERVING.md).
+
+    Part A -- contention: ``n_tenants`` record-plane tenants share one
+    Server process under a global credit cap, all flowing at once on
+    the same cores; per-tenant traced e2e p50/p99 and throughput are
+    reported (the per-tenant latency story of ROADMAP item 5).
+
+    Part B -- pay-for-what-you-use: ONE tenant runs uncontended twice,
+    arbiter enabled vs disabled (no SLO declared, so the arbiter has
+    nothing to defend); the deterministic sink fold (count, checksum)
+    must be BITWISE IDENTICAL and the enabled arbiter must have taken
+    zero decisions -- the control plane costs nothing until a breach
+    forces its hand.  Returns (rate_total, per_tenant, identical,
+    summary)."""
+    import warnings
+    import windflow_tpu as wf
+    from windflow_tpu.elastic import ElasticityConfig
+    from windflow_tpu.serving import ArbiterConfig, Server, TenantSpec
+
+    n_events = max(int(n_events), 30_000)
+    per_n = n_events // n_tenants
+
+    def build_for(n, acc):
+        def build(g):
+            state = {"i": 0}
+
+            def src(shipper):
+                i = state["i"]
+                if i >= n:
+                    return False
+                shipper.push(wf.BasicRecord(i % 8, i // 8, i // 8,
+                                            float(i % 101)))
+                state["i"] = i + 1
+                return True
+
+            def sink(r):
+                if r is not None:
+                    acc["n"] += 1
+                    acc["sum"] += r.value
+
+            g.add_source(wf.SourceBuilder(src).build()) \
+                .add(wf.MapBuilder(lambda t: wf.BasicRecord(
+                    t.key, t.id, t.ts, t.value * 1.0001)).build()) \
+                .add_sink(wf.SinkBuilder(sink).build())
+        return build
+
+    def tenant_cfg():
+        # dense tracing so tiny gate runs still close e2e traces
+        return wf.RuntimeConfig(
+            trace_sample=16,
+            elasticity=ElasticityConfig(enabled=False))
+
+    # -- part A: all tenants at once under one cap ---------------------
+    per_tenant = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        srv = Server(capacity=n_tenants * 4096, arbiter=ArbiterConfig())
+        try:
+            accs = [{"n": 0, "sum": 0.0} for _ in range(n_tenants)]
+            t0 = time.perf_counter()
+            handles = [
+                srv.submit(f"bench14-t{i}", build_for(per_n, accs[i]),
+                           TenantSpec(credits=4096, priority=i),
+                           config=tenant_cfg())
+                for i in range(n_tenants)]
+            for h in handles:
+                assert h.wait(600) == "COMPLETED", (h.name, h.error)
+            dt = time.perf_counter() - t0
+            for i, h in enumerate(handles):
+                stats = json.loads(h.graph.stats.to_json(0, 0))
+                e2e = stats.get("Latency_e2e") or {}
+                per_tenant.append({
+                    "tenant": h.name,
+                    "records": accs[i]["n"],
+                    "rate": round(accs[i]["n"] / dt, 1),
+                    "p50_ms": round((e2e.get("p50_us") or 0) / 1e3, 3),
+                    "p99_ms": round((e2e.get("p99_us") or 0) / 1e3, 3),
+                })
+        finally:
+            srv.close()
+    rate = sum(r["records"] for r in per_tenant) / dt
+
+    # -- part B: uncontended A/B, arbiter on vs off --------------------
+    def one(arbiter):
+        acc = {"n": 0, "sum": 0.0}
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            srv = Server(capacity=1 << 14, arbiter=arbiter)
+            try:
+                h = srv.submit("bench14-ab", build_for(per_n, acc),
+                               TenantSpec(credits=4096),
+                               config=tenant_cfg())
+                assert h.wait(600) == "COMPLETED", h.error
+                decisions = len(srv.arbiter.decisions) \
+                    if srv.arbiter is not None else 0
+            finally:
+                srv.close()
+        return acc, decisions
+
+    acc_on, decisions_on = one(ArbiterConfig(interval_s=0.2))
+    acc_off, _ = one(False)
+    identical = acc_on == acc_off
+    assert identical, ("arbiter-enabled uncontended run diverged",
+                       acc_on, acc_off)
+    assert decisions_on == 0, \
+        "arbiter actuated without any SLO breach"
+    summary = {"tenants": n_tenants,
+               "arbiter_decisions_uncontended": decisions_on,
+               "ab_identical": identical}
+    return rate, per_tenant, identical, summary
+
+
 def run_checkpoint_overhead(n_events, interval_s=1.0):
     """Config #11: the durability-plane overhead gate
     (docs/RESILIENCE.md "Exactly-once epochs").  The identical 2f-style
@@ -1505,6 +1618,18 @@ def main():
         "windows": w13,
         "overhead_frac": round(ovh13, 4),
         **slo13}
+    # multi-tenant serving plane (serving/; docs/SERVING.md): N
+    # record-plane tenants under one Server and global credit cap --
+    # per-tenant traced p50/p99 under contention, plus the
+    # pay-for-what-you-use proof (uncontended arbiter-on run bitwise
+    # identical to arbiter-off, zero decisions)
+    r14, tenants14, _ident14, mt14 = run_multitenant_contention(
+        N_EVENTS // 16)
+    configs["14_multitenant_contention"] = {
+        "rate": round(r14, 1),
+        "records": sum(t["records"] for t in tenants14),
+        "per_tenant": tenants14,
+        **mt14}
     for name, c in configs.items():
         n_out = c.get("windows", c.get("records", 0))
         print(f"[bench] {name}: {c['rate']:,.0f} tuples/s "

@@ -1,0 +1,889 @@
+"""Multi-tenant serving plane (windflow_tpu/serving/;
+docs/SERVING.md): dynamic graph submission/teardown against one shared
+runtime, per-tenant credit budgets + admission control under a global
+capacity cap, lifecycle-leak census, and the SLO-driven cross-tenant
+arbiter -- donor scaled down / credits reassigned to restore a
+breaching victim's SLO, every decision an ``arbitration`` flight event
+the doctor explains.
+
+Acceptance covered here: a >= 8-graph concurrent soak where one
+tenant's injected crash surfaces as a FAILED handle while every other
+tenant ends with balanced ledgers; a thread/fd census across repeated
+submit/evict cycles (including crash, mid-run stop and active elastic
+controller paths); and a scripted noisy-neighbor run where the
+arbiter's actions restore the victim tenant's declared SLO
+(slo_recovered fires) with victim, donor, action and evidence named
+in flight + doctor.
+"""
+import json
+import threading
+import time
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core.basic import RuntimeConfig
+from windflow_tpu.core.tuples import TupleBatch
+from windflow_tpu.diagnosis import build_report, render_text
+from windflow_tpu.elastic import ElasticityConfig
+from windflow_tpu.resilience import FaultPlan
+from windflow_tpu.serving import (AdmissionError, ArbiterConfig,
+                                  Donation, Server, TenantSpec,
+                                  TenantState, TenantView,
+                                  plan_arbitration, plan_restitution,
+                                  process_census)
+from windflow_tpu.serving.arbiter import (_spare_credits as _sp,
+                                          describe_actions)
+
+WAIT_S = 120
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def record_source(n, pace_s=0.0, endless=False):
+    state = {}
+
+    def fn(shipper, ctx):
+        i = state.setdefault("i", 0)
+        if not endless and i >= n:
+            return False
+        if pace_s:
+            time.sleep(pace_s)
+        shipper.push(wf.BasicRecord(i % 4, i // 4, i, float(i)))
+        state["i"] = i + 1
+        return True
+
+    return fn
+
+
+def simple_build(n=2000, sink_list=None, pace_s=0.0, endless=False):
+    def build(g):
+        sink = (lambda r: sink_list.append(r)) if sink_list is not None \
+            else (lambda r: None)
+        g.add_source(wf.SourceBuilder(
+            record_source(n, pace_s, endless)).build()) \
+            .add(wf.MapBuilder(lambda t: None).with_name("m").build()) \
+            .add_sink(wf.SinkBuilder(sink).build())
+    return build
+
+
+def quiet_cfg(tmp_path, **kw):
+    kw.setdefault("log_dir", str(tmp_path))
+    kw.setdefault("elasticity", ElasticityConfig(enabled=False))
+    return RuntimeConfig(**kw)
+
+
+def make_trace(n, n_keys=4):
+    ar = np.arange(n, dtype=np.int64)
+    return TupleBatch({"key": ar % n_keys, "id": ar // n_keys,
+                       "ts": ar // n_keys,
+                       "value": np.ones(n, np.float64)})
+
+
+@pytest.fixture
+def server():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        srv = Server(capacity=1 << 16, arbiter=False)
+        try:
+            yield srv
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# spec validation + admission control
+# ---------------------------------------------------------------------------
+
+def test_tenant_spec_validation():
+    with pytest.raises(ValueError):
+        TenantSpec(credits=0)
+    with pytest.raises(ValueError):
+        TenantSpec(weight=0.0)
+    with pytest.raises(ValueError):
+        TenantSpec(credits=100, min_credits=200)
+    with pytest.raises(ValueError):
+        TenantSpec(pool_buffers=0)
+    blk = TenantSpec(priority=3, weight=2.0).block()
+    assert blk["Priority"] == 3 and blk["Weight"] == 2.0
+
+
+def test_admission_over_cap_rejected_and_capacity_released(server,
+                                                           tmp_path):
+    cfg = quiet_cfg(tmp_path)
+    h = server.submit("a", simple_build(500),
+                      TenantSpec(credits=40_000), config=cfg)
+    assert server.granted == 40_000
+    with pytest.raises(AdmissionError, match="global cap"):
+        server.submit("b", simple_build(500),
+                      TenantSpec(credits=40_000), config=cfg)
+    # duplicate names rejected while registered
+    with pytest.raises(ValueError, match="already submitted"):
+        server.submit("a", simple_build(500), config=cfg)
+    assert h.wait(WAIT_S) == TenantState.COMPLETED
+    # terminal tenants release their reservation back to the cap...
+    assert server.granted == 0
+    server.evict("a")
+    # ...and eviction frees the name
+    h2 = server.submit("a", simple_build(300),
+                       TenantSpec(credits=40_000), config=cfg)
+    assert h2.wait(WAIT_S) == TenantState.COMPLETED
+
+
+def test_failed_build_releases_reservation(server, tmp_path):
+    def bad_build(g):
+        raise RuntimeError("boom at build time")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        server.submit("bad", bad_build, TenantSpec(credits=1024),
+                      config=quiet_cfg(tmp_path))
+    assert server.granted == 0
+    assert server.get("bad") is None
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: run to completion, stop mid-run, crash isolation
+# ---------------------------------------------------------------------------
+
+def test_submit_runs_and_publishes_tenant_block(server, tmp_path):
+    got = []
+    h = server.submit("alpha", simple_build(2000, got),
+                      TenantSpec(credits=1024, priority=2, weight=1.5),
+                      config=quiet_cfg(tmp_path))
+    assert h.wait(WAIT_S) == TenantState.COMPLETED
+    assert len(got) >= 2000
+    stats = json.loads(h.graph.stats.to_json(0, 0))
+    t = stats["Tenant"]
+    assert t["Name"] == "alpha" and t["State"] == "COMPLETED"
+    assert t["Priority"] == 2 and t["Credits"] == 1024
+    # clean end: the tenant's own ledger closed balanced
+    cons = stats["Conservation"]
+    assert cons["Edges_balanced"] and not cons["Violations_total"]
+    row = server.stats()["Tenants"][0]
+    assert row["Name"] == "alpha" and row["State"] == "COMPLETED"
+
+
+def test_stop_midrun_reclaims_and_reports_stopped(server, tmp_path):
+    h = server.submit("endless", simple_build(0, endless=True,
+                                              pace_s=0.0005),
+                      TenantSpec(credits=1024),
+                      config=quiet_cfg(tmp_path))
+    time.sleep(0.5)
+    assert h.state == TenantState.RUNNING
+    assert server.evict("endless").state == TenantState.STOPPED
+    assert h.error is None
+    assert server.granted == 0
+    # pool arena drained at teardown
+    pool = h.graph.buffer_pool
+    if pool is not None:
+        assert pool.stats()["buffers"] == 0
+
+
+def test_crash_isolated_as_failed_handle(server, tmp_path):
+    got = []
+    fp = FaultPlan(seed=7).crash_replica("m.0", at_tuple=50)
+    h_bad = server.submit("crashy", simple_build(5000),
+                          TenantSpec(credits=512),
+                          config=quiet_cfg(tmp_path, fault_plan=fp))
+    h_ok = server.submit("steady", simple_build(3000, got),
+                         TenantSpec(credits=512),
+                         config=quiet_cfg(tmp_path))
+    assert h_bad.wait(WAIT_S) == TenantState.FAILED
+    assert isinstance(h_bad.error, wf.NodeFailureError)
+    # the neighbour never noticed
+    assert h_ok.wait(WAIT_S) == TenantState.COMPLETED
+    assert len(got) >= 3000
+    stats = json.loads(h_ok.graph.stats.to_json(0, 0))
+    assert stats["Conservation"]["Edges_balanced"]
+
+
+# ---------------------------------------------------------------------------
+# acceptance: >= 8-graph soak, one tenant killed mid-run
+# ---------------------------------------------------------------------------
+
+def test_soak_eight_tenants_one_crash(tmp_path):
+    N_TENANTS, N_RECORDS = 8, 1500
+    sinks = {i: [] for i in range(N_TENANTS)}
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        srv = Server(capacity=1 << 16, arbiter=False)
+        try:
+            handles = {}
+            for i in range(N_TENANTS):
+                cfg = quiet_cfg(tmp_path)
+                if i == 3:  # the tenant that dies mid-run
+                    cfg.fault_plan = FaultPlan(seed=i).crash_replica(
+                        "m.0", at_tuple=200)
+                handles[i] = srv.submit(
+                    f"tenant-{i}", simple_build(N_RECORDS, sinks[i]),
+                    TenantSpec(credits=1024, priority=i % 3),
+                    config=cfg)
+            for i, h in handles.items():
+                want = TenantState.FAILED if i == 3 \
+                    else TenantState.COMPLETED
+                assert h.wait(WAIT_S) == want, (i, h.state, h.error)
+            # every surviving tenant: all records delivered and its own
+            # ledger balanced with zero violations at wait_end
+            for i, h in handles.items():
+                if i == 3:
+                    continue
+                assert len(sinks[i]) >= N_RECORDS
+                stats = json.loads(h.graph.stats.to_json(0, 0))
+                cons = stats["Conservation"]
+                assert cons["Edges_balanced"], (i, cons)
+                assert not cons["Violations_total"], (i, cons)
+                assert stats["Tenant"]["Name"] == f"tenant-{i}"
+            # per-tenant stats JSON is per-graph: 8 distinct reports
+            names = {json.loads(h.graph.stats.to_json(0, 0))
+                     ["PipeGraph_name"] for h in handles.values()}
+            assert len(names) == N_TENANTS
+            # teardown reclaims: census returns to the server baseline
+            for i in range(N_TENANTS):
+                srv.evict(f"tenant-{i}")
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: lifecycle-leak census across repeated cycles
+# ---------------------------------------------------------------------------
+
+def _census_settled(base, deadline_s=20.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        cen = process_census()
+        if cen["threads"] <= base["threads"] \
+                and (base["fds"] < 0 or cen["fds"] <= base["fds"]):
+            return cen
+        time.sleep(0.2)
+    return process_census()
+
+
+def test_census_no_thread_or_fd_leak_across_cycles(tmp_path):
+    def build(g):
+        g.add_source(wf.SourceBuilder(record_source(1500)).build()) \
+            .add(wf.MapBuilder(lambda t: None).with_name("m")
+                 .with_key_by().with_parallelism(2)
+                 .with_elasticity(1, 4).build()) \
+            .add_sink(wf.SinkBuilder(lambda r: None).build())
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        srv = Server(capacity=1 << 16, arbiter=False)
+        try:
+            # warmup cycle: lazily-built singletons must not read as
+            # leaks (jax state, first monitor socket, ...)
+            srv.submit("warm", build, TenantSpec(credits=512),
+                       config=quiet_cfg(tmp_path)).wait(WAIT_S)
+            srv.evict("warm")
+            base = _census_settled(process_census())
+            for cycle in range(2):
+                # clean completion with the elastic controller ACTIVE
+                # (SignalSampler is a census suspect)
+                h = srv.submit("el", build, TenantSpec(credits=512),
+                               config=RuntimeConfig(
+                                   log_dir=str(tmp_path)))
+                assert h.wait(WAIT_S) == TenantState.COMPLETED
+                srv.evict("el")
+                # injected crash (failure teardown path)
+                fp = FaultPlan(seed=cycle).crash_replica("m.0",
+                                                         at_tuple=100)
+                h = srv.submit("crash", build, TenantSpec(credits=512),
+                               config=quiet_cfg(tmp_path,
+                                                fault_plan=fp))
+                assert h.wait(WAIT_S) == TenantState.FAILED
+                srv.evict("crash")
+                # cancelled mid-run (stop teardown path)
+                h = srv.submit(
+                    "run", lambda g: simple_build(
+                        0, endless=True, pace_s=0.0005)(g),
+                    TenantSpec(credits=512),
+                    config=quiet_cfg(tmp_path))
+                time.sleep(0.4)
+                assert srv.evict("run").state == TenantState.STOPPED
+            cen = _census_settled(base)
+            extra = [n for n in cen["names"]
+                     if n not in base["names"]]
+            assert cen["threads"] <= base["threads"], (base, cen, extra)
+            if base["fds"] >= 0:
+                assert cen["fds"] <= base["fds"], (base, cen)
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# arbiter policy (pure planner)
+# ---------------------------------------------------------------------------
+
+def _view(name, **kw):
+    kw.setdefault("credits", 4096)
+    kw.setdefault("min_credits", 256)
+    return TenantView(name=name, **kw)
+
+
+CFG = ArbiterConfig(breach_ticks=2, cooldown_s=5.0)
+
+
+def test_plan_no_victim_or_no_donor_is_noop():
+    views = [_view("a", breached=False), _view("b", breached=False)]
+    assert plan_arbitration(views, CFG, {}, {}, 0.0) is None
+    # a breached victim with no other tenant: nothing to take
+    views = [_view("a", breached=True)]
+    assert plan_arbitration(views, CFG, {"a": 5}, {}, 0.0) is None
+    # the only donor is itself breached
+    views = [_view("a", breached=True),
+             _view("b", breached=True)]
+    assert plan_arbitration(views, CFG, {"a": 5, "b": 5}, {}, 0.0) \
+        is None
+
+
+def test_plan_respects_breach_hysteresis_and_cooldown():
+    views = [_view("a", breached=True), _view("b", breached=False)]
+    # breach not yet sustained breach_ticks
+    assert plan_arbitration(views, CFG, {"a": 1}, {}, 0.0) is None
+    # sustained: decision fires
+    d = plan_arbitration(views, CFG, {"a": 2}, {}, 0.0)
+    assert d and d["victim"] == "a" and d["donor"] == "b"
+    # donor inside its cooldown window: hold
+    assert plan_arbitration(views, CFG, {"a": 2}, {"b": 10.0}, 5.0) \
+        is None
+    assert plan_arbitration(views, CFG, {"a": 2}, {"b": 10.0}, 11.0)
+
+
+def test_plan_priority_and_weight_ordering():
+    views = [
+        _view("low-vic", breached=True, priority=1),
+        _view("high-vic", breached=True, priority=5),
+        _view("heavy-donor", breached=False, priority=0, weight=4.0),
+        _view("light-donor", breached=False, priority=0, weight=1.0),
+        _view("vip-donor", breached=False, priority=9),
+    ]
+    runs = {v.name: 9 for v in views}
+    d = plan_arbitration(views, CFG, runs, {}, 0.0)
+    # worst victim first; cheapest donor first (lowest priority, then
+    # lowest weight) -- the priority-9 tenant is never squeezed for a
+    # priority-5 victim... but IS eligible for nobody here
+    assert d["victim"] == "high-vic"
+    assert d["donor"] == "light-donor"
+    # a donor of strictly higher priority than the victim is exempt
+    views2 = [_view("vic", breached=True, priority=1),
+              _view("vip", breached=False, priority=2)]
+    assert plan_arbitration(views2, CFG, {"vic": 9, "vip": 0},
+                            {}, 0.0) is None
+
+
+def test_plan_actions_halve_parallelism_and_move_spare_credits():
+    views = [
+        _view("vic", breached=True, violating=("throughput",),
+              values={"throughput_rps": 3.0}, burn_fast=10.0),
+        _view("don", breached=False, credits=4096, min_credits=256,
+              elastic=[("pipe0/burn", 4, 1, 8)]),
+    ]
+    d = plan_arbitration(views, CFG, {"vic": 2, "don": 0}, {}, 0.0)
+    kinds = {a["type"]: a for a in d["actions"]}
+    assert kinds["rescale"]["old"] == 4 and kinds["rescale"]["new"] == 2
+    # half the SPARE lease (above the floor), per the documented step
+    assert kinds["credits"]["moved"] == (4096 - 256) // 2
+    assert d["evidence"]["violating"] == ["throughput"]
+    # a donor hugging its floor still converges (min step 1), and the
+    # step can never dig below the floor
+    tight = _view("don2", breached=False, credits=260, min_credits=256)
+    assert 1 <= _sp(tight, 0.5) <= 4
+    assert _sp(_view("don3", credits=256, min_credits=256), 0.5) == 0
+    # at the floors there is nothing left to give
+    views[1] = _view("don", breached=False, credits=256,
+                     min_credits=256, elastic=[("pipe0/burn", 1, 1, 8)])
+    assert plan_arbitration(views, CFG, {"vic": 2, "don": 0},
+                            {}, 0.0) is None
+
+
+def test_plan_restitution_after_clear_or_departure():
+    cfg = ArbiterConfig(clear_ticks=3)
+    don = [Donation(victim="vic", donor="don", operator="op",
+                    old_parallelism=4, new_parallelism=2),
+           Donation(victim="vic", donor="don", credits_moved=512)]
+    views = [_view("vic", breached=False), _view("don")]
+    # not clear long enough yet
+    assert plan_restitution(views, cfg, don, {"vic": 2}) is None
+    # clear: newest donation returns first
+    d = plan_restitution(views, cfg, don, {"vic": 3})
+    assert d is don[1]
+    # a departed victim releases its squeezes too
+    assert plan_restitution([_view("don")], cfg, don, {}) is don[1]
+    # still breached: hold
+    views[0] = _view("vic", breached=True)
+    assert plan_restitution(views, cfg, don, {"vic": 0}) is None
+
+
+def test_stacked_rescale_donations_unwind_lifo(server, tmp_path):
+    """Two squeezes on one operator store absolute parallelisms;
+    restoring the OLDER one while the newer is still applied would
+    silently undo an active squeeze (review finding) -- restitution
+    must unwind strictly newest-first."""
+    stop = threading.Event()
+
+    def build(g):
+        g.add_source(wf.SourceBuilder(
+            record_source(0, pace_s=0.001, endless=True)).build()) \
+            .add(wf.MapBuilder(lambda t: None).with_name("m")
+                 .with_key_by().with_parallelism(4)
+                 .with_elasticity(1, 4).build()) \
+            .add_sink(wf.SinkBuilder(lambda r: None).build())
+
+    h = server.submit("don", build, TenantSpec(credits=1024),
+                      config=quiet_cfg(tmp_path))
+    try:
+        op = next(iter(h.graph.elastic))
+        h.graph.rescale(op, 2)   # the squeezes the donations recorded
+        h.graph.rescale(op, 1)
+        d1 = Donation(victim="x", donor="don", operator=op,
+                      old_parallelism=4, new_parallelism=2,
+                      victim_departed=True)
+        d2 = Donation(victim="y", donor="don", operator=op,
+                      old_parallelism=2, new_parallelism=1,
+                      victim_departed=True)
+        # older first: current parallelism (1) != d1.new (2) -> held
+        assert not server.apply_restitution(d1)
+        assert d1.operator == op          # still ledgered, not moot
+        assert next(iter(h.graph.elastic.values())).parallelism == 1
+        # newest first: restores 1 -> 2, then d1 restores 2 -> 4
+        assert server.apply_restitution(d2)
+        assert next(iter(h.graph.elastic.values())).parallelism == 2
+        assert server.apply_restitution(d1)
+        assert next(iter(h.graph.elastic.values())).parallelism == 4
+    finally:
+        stop.set()
+        h.graph.cancel()
+        h.wait(WAIT_S)
+
+
+def test_forget_scrubs_donation_ledger_on_name_reuse():
+    """A re-submitted name must not inherit a departed namesake's
+    ledger: its donations die with it, and donations OWED BY the
+    departed victim fire as restitution instead of resolving against
+    the new tenant (review finding)."""
+    from windflow_tpu.serving import CrossTenantArbiter
+    arb = CrossTenantArbiter.__new__(CrossTenantArbiter)
+    arb._state_lock = threading.Lock()
+    arb._breach_runs, arb._clear_runs, arb._cooldowns = {}, {}, {}
+    arb.donations = [
+        Donation(victim="v", donor="reused", credits_moved=100),
+        Donation(victim="reused", donor="other", credits_moved=200),
+    ]
+    arb._breach_runs["reused"] = 5
+    arb.forget("reused")
+    assert len(arb.donations) == 1          # donor's squeeze died
+    assert arb.donations[0].victim == "reused"
+    assert arb.donations[0].victim_departed  # flagged, not resolved
+    assert "reused" not in arb._breach_runs
+    # plan_restitution treats the flagged entry's victim as gone even
+    # though a live view carries the reused name
+    views = [_view("reused", breached=True), _view("other")]
+    d = plan_restitution(views, ArbiterConfig(), arb.donations, {})
+    assert d is arb.donations[0]
+
+
+def test_describe_actions_strings():
+    s = describe_actions(
+        [{"type": "rescale", "operator": "pipe0/acc", "old": 4,
+          "new": 2},
+         {"type": "credits", "moved": 2048}], "tenant-b", "tenant-a")
+    assert "scaled pipe0/acc@tenant-b 4→2" in s
+    assert "granted 2048 credits to tenant-a" in s
+    s = describe_actions([{"type": "rescale", "operator": "op",
+                           "old": 1, "new": 4}], "d", "v",
+                         restore=True)
+    assert "restored" in s
+
+
+# ---------------------------------------------------------------------------
+# credit actuation against live ingest gates
+# ---------------------------------------------------------------------------
+
+def ingest_build(n):
+    def build(g):
+        src = wf.SourceBuilder.from_replay(make_trace(n), speedup=None,
+                                           chunk=256).build()
+        g.add_source(src).add_sink(
+            wf.SinkBuilder(lambda b: None).build())
+    return build
+
+
+def test_credit_moves_resize_live_gates(server, tmp_path):
+    h_a = server.submit("ing-a", ingest_build(20_000),
+                        TenantSpec(credits=4096),
+                        config=quiet_cfg(tmp_path))
+    h_b = server.submit("ing-b", ingest_build(20_000),
+                        TenantSpec(credits=4096),
+                        config=quiet_cfg(tmp_path))
+    assert len(h_a._ingest) == 1 and len(h_b._ingest) == 1
+    assert h_a._ingest[0].gate.budget == 4096
+    decision = {"victim": "ing-a", "donor": "ing-b",
+                "actions": [{"type": "credits", "moved": 2048}],
+                "evidence": {"violating": ["throughput"]}}
+    assert server.apply_arbitration(decision)
+    assert h_b.credits == 2048 and h_a.credits == 4096 + 2048
+    assert h_b._ingest[0].gate.budget == 2048
+    assert h_a._ingest[0].gate.budget == 4096 + 2048
+    # both tenants' flight rings carry the arbitration evidence
+    for h in (h_a, h_b):
+        evs = [e for e in h.graph.flight.snapshot()
+               if e["kind"] == "arbitration"]
+        assert evs and evs[0]["victim"] == "ing-a" \
+            and evs[0]["donor"] == "ing-b"
+        assert "granted 2048 credits" in evs[0]["action"]
+    # restitution returns the credits
+    assert server.apply_restitution(
+        Donation(victim="ing-a", donor="ing-b", credits_moved=2048))
+    assert h_b.credits == 4096 and h_a.credits == 4096
+    assert h_a.wait(WAIT_S) == TenantState.COMPLETED
+    assert h_b.wait(WAIT_S) == TenantState.COMPLETED
+    # shed/dead letters (none here) stay per-tenant by construction
+    assert h_a.graph.dead_letters.count() == 0
+    # terminal tenants refuse further credit moves (the lease already
+    # returned to the cap; a grant now would corrupt the accounting)
+    assert server._transfer_credits(h_b, h_a, 100) == 0
+    assert not server.apply_arbitration(
+        {"victim": "ing-a", "donor": "ing-b",
+         "actions": [{"type": "credits", "moved": 100}],
+         "evidence": {}})
+
+
+def test_live_gate_resize_never_wedges_blocked_acquire():
+    """The arbiter resizes CreditGates on RUNNING tenants: an acquire
+    blocked against the OLD budget must re-read the new one, or a
+    downward squeeze wedges the donor source forever (review
+    finding -- release() clamps available at the new budget, so a
+    stale `need` above it could never be satisfied)."""
+    from windflow_tpu.ingest import CreditGate
+    gate = CreditGate(4096)
+    assert gate.acquire(4096)          # drain the whole budget
+    got = threading.Event()
+
+    def blocked():
+        gate.acquire(1024)             # need > post-resize budget
+        got.set()
+
+    t = threading.Thread(target=blocked, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert not got.is_set()
+    gate.resize(256)                   # live squeeze below the need
+    gate.release(4096)                 # consumer drains; avail -> 256
+    assert got.wait(5.0), "blocked acquire wedged against old budget"
+    # an upward resize wakes waiters promptly too
+    gate2 = CreditGate(64)
+    assert gate2.acquire(64)
+    got2 = threading.Event()
+    t2 = threading.Thread(
+        target=lambda: (gate2.acquire(128), got2.set()), daemon=True)
+    t2.start()
+    time.sleep(0.1)
+    gate2.resize(512)                  # grows available by 448 >= 128
+    assert got2.wait(5.0)
+
+
+def test_restitution_after_victim_left_still_recorded(server,
+                                                      tmp_path):
+    """A restitution firing after the victim was evicted must still
+    restore the donor AND record an arbitration event on the donor's
+    ring (every actuation is explained -- review finding)."""
+    h_b = server.submit("donor", ingest_build(50_000),
+                        TenantSpec(credits=2048),
+                        config=quiet_cfg(tmp_path))
+    granted0 = server.granted
+    assert server.apply_restitution(
+        Donation(victim="long-gone", donor="donor",
+                 credits_moved=512))
+    assert h_b.credits == 2048 + 512
+    assert server.granted == granted0 + 512   # re-reserved under cap
+    evs = [e for e in h_b.graph.flight.snapshot()
+           if e["kind"] == "arbitration"]
+    assert evs and evs[-1]["victim"] == "long-gone"
+    assert "returned 512 credits" in evs[-1]["action"]
+    assert h_b.arbitrations == 1
+    assert h_b.wait(WAIT_S) == TenantState.COMPLETED
+
+
+def test_partial_restitution_keeps_remainder_ledgered(tmp_path):
+    """When the cap can only absorb part of a gone victim's give-back,
+    the Donation keeps its remainder for a later tick instead of
+    forfeiting the donor's lease (review finding)."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        srv = Server(capacity=4096, arbiter=False)
+        try:
+            h = srv.submit("don", ingest_build(50_000),
+                           TenantSpec(credits=2048),
+                           config=quiet_cfg(tmp_path))
+            d = Donation(victim="gone", donor="don",
+                         credits_moved=4096)     # > cap room (2048)
+            assert srv.apply_restitution(d)
+            assert h.credits == 2048 + 2048      # clamped give-back
+            assert d.credits_moved == 2048       # remainder survives
+            assert srv.granted == 4096
+            assert h.wait(WAIT_S) == TenantState.COMPLETED
+        finally:
+            srv.close()
+
+
+def test_failed_restitution_stays_ledgered(server, tmp_path):
+    """A restore that cannot apply keeps its Donation ledgered so the
+    arbiter retries instead of stranding the donor squeezed (review
+    finding): with the donor gone, the entry is dropped instead.  An
+    operator that no longer resolves in the elastic registry is moot
+    and its entry drops immediately."""
+    from windflow_tpu.serving import CrossTenantArbiter
+    arb = CrossTenantArbiter(server, ArbiterConfig(clear_ticks=1))
+
+    def build(g):
+        g.add_source(wf.SourceBuilder(
+            record_source(0, pace_s=0.001, endless=True)).build()) \
+            .add(wf.MapBuilder(lambda t: None).with_name("m")
+                 .with_key_by().with_parallelism(2)
+                 .with_elasticity(1, 4).build()) \
+            .add_sink(wf.SinkBuilder(lambda r: None).build())
+
+    h = server.submit("don", build, TenantSpec(credits=1024),
+                      config=quiet_cfg(tmp_path))
+    op = next(iter(h.graph.elastic))
+    # old_parallelism above max_replicas: the restore rescale RAISES
+    # -> applied False -> the donation must survive for a retry
+    arb.donations.append(Donation(victim="gone", donor="don",
+                                  operator=op, old_parallelism=8,
+                                  new_parallelism=2,
+                                  victim_departed=True))
+    arb.tick(now=0.0)
+    assert len(arb.donations) == 1, "failed restore dropped the ledger"
+    # an unresolvable operator is moot: dropped, not retried forever
+    arb.donations.append(Donation(victim="gone", donor="don",
+                                  operator="no/such_op",
+                                  old_parallelism=4,
+                                  new_parallelism=2,
+                                  victim_departed=True))
+    arb.tick(now=1.0)
+    assert len(arb.donations) == 1
+    assert arb.donations[0].old_parallelism == 8
+    # donor terminal: nothing left to restore to -> entry dropped
+    h.graph.cancel()
+    h.wait(WAIT_S)
+    arb.tick(now=2.0)
+    assert not arb.donations
+
+
+# ---------------------------------------------------------------------------
+# acceptance: scripted noisy neighbour, arbiter restores the SLO
+# ---------------------------------------------------------------------------
+
+def burner_source(stop_evt):
+    state = {}
+
+    def fn(shipper, ctx):
+        if stop_evt.is_set():
+            return False
+        i = state.setdefault("i", 0)
+        shipper.push(wf.BasicRecord(i % 64, i, i, 1.0))
+        state["i"] = i + 1
+        return True
+
+    return fn
+
+
+def burn_10ms(t):
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 0.01:
+        pass
+    return None
+
+
+def test_noisy_neighbor_arbiter_restores_victim_slo(tmp_path):
+    """The ISSUE-14 acceptance script: tenant-a declares a throughput
+    SLO and is starved by tenant-b's CPU burners; the arbiter scales
+    the donor down (and reassigns credits), the victim's SLO recovers
+    (slo_recovered fires), and flight + doctor name victim, donor,
+    action and evidence for every decision."""
+    stop = threading.Event()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        srv = Server(
+            capacity=1 << 16,
+            arbiter=ArbiterConfig(interval_s=0.25, breach_ticks=2,
+                                  cooldown_s=1.0,
+                                  clear_ticks=10 ** 6))
+        try:
+            vcfg = quiet_cfg(tmp_path, diagnosis_interval_s=0.2,
+                             audit_interval_s=0.1)
+            bcfg = quiet_cfg(tmp_path, queue_capacity=32)
+
+            def build_victim(g):
+                g.add_source(wf.SourceBuilder(
+                    record_source(10 ** 6, pace_s=0.001)).build()) \
+                    .add(wf.MapBuilder(lambda t: None)
+                         .with_name("vmap").build()) \
+                    .add_sink(wf.SinkBuilder(lambda r: None).build())
+
+            def build_noisy(g):
+                g.add_source(wf.SourceBuilder(
+                    burner_source(stop)).build()) \
+                    .add(wf.MapBuilder(burn_10ms).with_name("burn")
+                         .with_key_by().with_parallelism(4)
+                         .with_elasticity(1, 4).build()) \
+                    .add_sink(wf.SinkBuilder(lambda r: None).build())
+
+            hv = srv.submit(
+                "tenant-a", build_victim,
+                TenantSpec(credits=1024, priority=5,
+                           slo=dict(min_throughput_rps=60.0,
+                                    target=0.9, fast_window_s=3.0,
+                                    slow_window_s=30.0,
+                                    warmup_ticks=1,
+                                    fast_burn=2.0)),
+                config=vcfg)
+            hb = srv.submit("tenant-b", build_noisy,
+                            TenantSpec(credits=4096, priority=0),
+                            config=bcfg)
+            # phase A: contention starves the victim -> breach
+            deadline = time.monotonic() + WAIT_S
+            while time.monotonic() < deadline:
+                tr = hv.graph.diagnosis.slo
+                if tr is not None and tr.breached:
+                    break
+                time.sleep(0.2)
+            assert hv.graph.diagnosis.slo.breached, \
+                "victim never breached under contention"
+            # phase B: the arbiter squeezes the donor until the
+            # victim's episode closes
+            recovered = False
+            deadline = time.monotonic() + WAIT_S
+            while time.monotonic() < deadline:
+                kinds = [e["kind"]
+                         for e in hv.graph.flight.snapshot()]
+                if "slo_recovered" in kinds:
+                    recovered = True
+                    break
+                time.sleep(0.25)
+            decisions = srv.arbiter.decisions
+            assert decisions, "arbiter never actuated"
+            assert recovered, \
+                (f"victim SLO never recovered; donor at "
+                 f"{[h.parallelism for h in hb.graph.elastic.values()]}, "
+                 f"{len(decisions)} decisions")
+            # the donor was actually scaled down
+            assert all(h.parallelism < 4
+                       for h in hb.graph.elastic.values())
+            # every decision names victim, donor, action, evidence
+            for h in (hv, hb):
+                evs = [e for e in h.graph.flight.snapshot()
+                       if e["kind"] == "arbitration"]
+                assert evs
+                for e in evs:
+                    assert e["victim"] == "tenant-a"
+                    assert e["donor"] == "tenant-b"
+                    assert e["action"]
+                    assert "violating" in e["evidence"]
+            # ...and the doctor explains them in prose
+            txt = render_text(srv.explain("tenant-a"))
+            assert "arbitrations (cross-tenant):" in txt
+            assert "tenant-b -> tenant-a" in txt
+            assert "scaled" in txt or "granted" in txt
+            # server-level stats carry the arbitration counts
+            rows = {r["Name"]: r
+                    for r in srv.stats()["Tenants"]}
+            assert rows["tenant-a"]["Arbitrations"] >= 1
+            assert rows["tenant-b"]["Arbitrations"] >= 1
+        finally:
+            stop.set()
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# observability: dashboard index/tenants endpoints, /metrics families,
+# doctor Arbitrations block
+# ---------------------------------------------------------------------------
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.read().decode()
+
+
+def test_dashboard_index_tenants_and_metrics(tmp_path):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        srv = Server(capacity=1 << 16, arbiter=False, http_port=0)
+        try:
+            port = srv.httpd.server_address[1]
+            base = f"http://127.0.0.1:{port}"
+            h = srv.submit("web-tenant",
+                           simple_build(0, endless=True,
+                                        pace_s=0.0005),
+                           TenantSpec(credits=2048, priority=1),
+                           config=quiet_cfg(tmp_path))
+            # wait for the tenant's first monitor report to land
+            deadline = time.monotonic() + WAIT_S
+            idx = {}
+            while time.monotonic() < deadline:
+                idx = json.loads(_get(base + "/index"))
+                if any((v.get("tenant") or {}).get("Name")
+                       == "web-tenant" for v in idx.values()):
+                    break
+                time.sleep(0.2)
+            rows = [v for v in idx.values()
+                    if (v.get("tenant") or {}).get("Name")
+                    == "web-tenant"]
+            assert rows, idx
+            row = rows[0]
+            assert row["graph"] == "web-tenant" and row["active"]
+            assert set(row["links"]) == {"apps", "explain", "flight",
+                                         "metrics"}
+            aid = row["links"]["apps"].split("=")[-1]
+            # per-app filter narrows /apps to the requested app
+            filtered = json.loads(_get(base + f"/apps?app={aid}"))
+            assert list(filtered) == [aid]
+            # /tenants: per-app Tenant blocks + the Server's own view
+            tens = json.loads(_get(base + "/tenants"))
+            assert tens["apps"][aid]["Name"] == "web-tenant"
+            assert tens["server"]["Tenants"][0]["Name"] == "web-tenant"
+            assert tens["server"]["Capacity"] == 1 << 16
+            # /metrics: the windflow_tenant_* families
+            metrics = _get(base + "/metrics")
+            assert 'windflow_tenant_up{' in metrics
+            assert 'tenant="web-tenant"' in metrics
+            assert "windflow_tenant_credits" in metrics
+            assert "windflow_tenant_arbitrations_total" in metrics
+            try:  # strict parser, when available (as in test_audit)
+                from prometheus_client.openmetrics import parser
+                list(parser.text_string_to_metric_families(metrics))
+            except ImportError:
+                pass
+            assert h.state == TenantState.RUNNING
+        finally:
+            srv.close()
+
+
+def test_report_arbitrations_block_and_rendering():
+    flight = [
+        {"t": 1.0, "seq": 0, "kind": "arbitration",
+         "victim": "tenant-a", "donor": "tenant-b",
+         "action": "scaled acc@tenant-b 4→2, granted 2k credits to "
+                   "tenant-a",
+         "detail": "p99 42 ms over budget, 42% budget burned",
+         "evidence": {"violating": ["e2e_p99"]}},
+        {"t": 2.0, "seq": 1, "kind": "shed", "node": "x"},
+    ]
+    rep = build_report({"PipeGraph_name": "g"}, flight)
+    assert len(rep["Arbitrations"]) == 1
+    a = rep["Arbitrations"][0]
+    assert a["victim"] == "tenant-a" and a["donor"] == "tenant-b"
+    txt = render_text(rep)
+    assert "arbitrations (cross-tenant):" in txt
+    assert "tenant-b -> tenant-a: scaled acc@tenant-b 4→2" in txt
+    assert "p99 42 ms over budget" in txt
+    # absent entirely when no arbitration happened
+    rep2 = build_report({"PipeGraph_name": "g"}, [])
+    assert rep2["Arbitrations"] == []
+    assert "arbitrations" not in render_text(rep2)

@@ -696,7 +696,8 @@ GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
 REPORT_KEYS = {
     "Graph", "Schema_version", "Verdict", "Bottleneck", "Attribution",
     "Anomalies", "Anomalies_total", "Slo", "Conservation",
-    "Durability", "Hot_keys", "History", "Failures", "Flight_tail",
+    "Durability", "Hot_keys", "History", "Failures", "Arbitrations",
+    "Flight_tail",
 }
 
 

@@ -162,6 +162,23 @@ def measure() -> tuple:
         bench.run_slo_overhead(N_SMALL)
     out["13_slo_feed"] = round(r13_on, 1)
     out["13_no_slo_feed"] = round(r13_off, 1)
+    # multi-tenant serving smoke (serving/; docs/SERVING.md): N record
+    # tenants under one Server + global cap; the helper itself asserts
+    # the uncontended arbiter-on/off A/B is bitwise identical with
+    # zero decisions (pay-for-what-you-use), so the gated rate mostly
+    # catches a serialized/wedged serving plane.  Per-tenant p99 rides
+    # the latency gate.
+    r14, tenants14, ident14, _mt14 = \
+        bench.run_multitenant_contention(N_SMALL // 8)
+    assert ident14, "arbiter-on uncontended run diverged"
+    out["14_multitenant_contention"] = round(r14, 1)
+    # both stats from the SAME p99-qualified tenant set, so the pair
+    # is coherent (p50 from one tenant and p99 from another could
+    # even record p50 > p99)
+    qual = [t for t in tenants14 if t.get("p99_ms")]
+    lats["14_multitenant_contention"] = (
+        {"p50_ms": max(t.get("p50_ms") or 0 for t in qual),
+         "p99_ms": max(t["p99_ms"] for t in qual)} if qual else None)
     return out, {k: v for k, v in lats.items() if v}
 
 

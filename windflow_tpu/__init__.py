@@ -78,6 +78,14 @@ def __getattr__(name):
         "wire_table": "windflow_tpu.distributed",
         "check_wire_conservation": "windflow_tpu.distributed",
         "MsgDecoder": "windflow_tpu.distributed",
+        # multi-tenant serving plane (serving/; docs/SERVING.md)
+        "Server": "windflow_tpu.serving",
+        "TenantSpec": "windflow_tpu.serving",
+        "TenantHandle": "windflow_tpu.serving",
+        "TenantState": "windflow_tpu.serving",
+        "AdmissionError": "windflow_tpu.serving",
+        "ArbiterConfig": "windflow_tpu.serving",
+        "CrossTenantArbiter": "windflow_tpu.serving",
         # durability plane (durability/; docs/RESILIENCE.md
         # "Exactly-once epochs")
         "EpochCoordinator": "windflow_tpu.durability",

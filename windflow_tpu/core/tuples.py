@@ -79,6 +79,20 @@ class ColumnPool:
         return {"buffers": held, "bytes": held_bytes,
                 "hits": self.hits, "misses": self.misses}
 
+    def drain(self) -> int:
+        """Release the arena: drop the pool's strong references to
+        every pooled base buffer, returning the byte count let go.
+        Buffers with live outside views survive exactly as long as
+        those views do (refcounting, not the pool, owns them now); the
+        pool stays usable and simply re-allocates on the next take.
+        The serving plane calls this at tenant teardown so repeated
+        submit/evict cycles reclaim arena memory (docs/SERVING.md)."""
+        with self._lock:
+            released = sum(buf.nbytes for b in self._buckets.values()
+                           for buf in b)
+            self._buckets.clear()
+        return released
+
 
 @runtime_checkable
 class WFRecord(Protocol):

@@ -88,6 +88,17 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
         }
     failures = [e for e in flight
                 if e.get("kind") in ("node_failure", "stall")]
+    # serving plane (serving/; docs/SERVING.md): cross-tenant arbiter
+    # decisions involving this graph -- the doctor names victim,
+    # donor, action and evidence for every one
+    arbitrations = [{
+        "t": e.get("t"),
+        "victim": e.get("victim"),
+        "donor": e.get("donor"),
+        "action": e.get("action"),
+        "detail": e.get("detail"),
+        "evidence": e.get("evidence"),
+    } for e in flight if e.get("kind") == "arbitration"]
     dur = stats.get("Durability")
     durability = None
     if dur:
@@ -114,6 +125,7 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
         "Hot_keys": hot,
         "History": history,
         "Failures": failures,
+        "Arbitrations": arbitrations[-FLIGHT_TAIL:],
         "Flight_tail": list(flight)[-FLIGHT_TAIL:],
     }
     report["Verdict"] = _verdict(report)
@@ -284,6 +296,16 @@ def render_text(report: dict) -> str:
                    f"stalled={dur['Stalled']}"
                    + (f" restored_from={restored}"
                       if restored is not None else ""))
+    arbs = report.get("Arbitrations") or []
+    if arbs:
+        out.append("")
+        out.append("arbitrations (cross-tenant):")
+        for a in arbs:
+            line = f"  [{a.get('t')}] {a.get('donor')} -> " \
+                   f"{a.get('victim')}: {a.get('action')}"
+            if a.get("detail"):
+                line += f": {a['detail']}"
+            out.append(line)
     hot = report.get("Hot_keys") or []
     if hot:
         out.append("hot keys: " + ", ".join(

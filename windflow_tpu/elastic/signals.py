@@ -198,3 +198,7 @@ class SignalSampler(threading.Thread):
 
     def stop(self) -> None:
         self._stop_evt.set()
+        # join so repeated start/teardown cycles in one process leave
+        # no sampler thread behind (the serving plane's census test)
+        if self.is_alive() and threading.current_thread() is not self:
+            self.join(timeout=5.0)

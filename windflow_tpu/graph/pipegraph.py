@@ -362,6 +362,11 @@ class PipeGraph:
                 src = source_loop_of(n.logic)
                 if src is not None:
                     src.pause_control = self._pause_ctl
+                    # cancellation check at generation-step boundaries:
+                    # a fully fused source chain has no channel whose
+                    # poisoning could unblock it (runtime/node.py
+                    # SourceLoopLogic.eos_flush)
+                    src.cancel_token = self._cancel
         # audit plane (audit/; docs/OBSERVABILITY.md): attach the
         # per-edge delivery books, outlet put-fault state and KEYBY
         # hot-key sketches AFTER fusion/ingest wiring and fault binding

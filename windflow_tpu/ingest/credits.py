@@ -64,13 +64,19 @@ class CreditGate:
         self._observer = observer
 
     def resize(self, budget: int) -> None:
-        """Pre-start rebudget (wiring applies RuntimeConfig defaults to
-        gates built with the library default)."""
+        """Rebudget -- pre-start (wiring applies RuntimeConfig
+        defaults) or LIVE (the serving plane's arbiter moves credits
+        between running tenants, docs/SERVING.md).  Waiters are woken
+        so an upward resize unblocks promptly, and ``acquire``
+        re-reads the budget inside its wait loop so a downward resize
+        can never wedge a blocked source against a need the new
+        budget can no longer satisfy."""
         if budget < 1:
             raise ValueError("credit budget must be >= 1")
-        with self._lock:
+        with self._avail:
             self.available += budget - self.budget
             self.budget = budget
+            self._avail.notify_all()
 
     def outstanding(self) -> int:
         with self._lock:
@@ -92,13 +98,16 @@ class CreditGate:
         when ``n`` exceeds it).  Returns False on timeout -- the
         admission layer's shed trigger.  Raises GraphCancelled once the
         owning graph is cancelled."""
-        need = min(n, self.budget)
         deadline = None if timeout is None else _time.monotonic() + timeout
         with self._avail:
-            if self.available < need:
+            if self.available < min(n, self.budget):
                 self.credit_waits += 1
                 t0 = _time.monotonic()
-                while self.available < need:
+                # re-read the budget each pass: a live resize may have
+                # shrunk it below a captured `need`, which release()'s
+                # budget clamp could then never satisfy (permanent
+                # wedge of the blocked source)
+                while self.available < min(n, self.budget):
                     if self.poisoned:
                         raise GraphCancelled("credit gate poisoned")
                     if deadline is None:

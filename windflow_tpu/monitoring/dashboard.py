@@ -104,33 +104,95 @@ class DashboardServer(threading.Thread):
             return json.loads(json.dumps(self.apps))
 
 
-def serve_http(dash: DashboardServer, port: int = 20208):
+def serve_http(dash: DashboardServer, port: int = 20208, server=None):
     """Expose the dashboard over HTTP: the self-contained HTML
     front-end at ``/`` (webui.py -- the React-dashboard equivalent),
-    the OpenMetrics text exposition at ``/metrics`` (telemetry/
-    metrics.py -- point a Prometheus scraper here and every traced
-    graph's counters and latency histograms come along), the
-    diagnosis surfaces at ``/flight`` (per-app FlightRecorder ring, as
-    shipped inside the monitor reports -- reachable without a stall or
-    crash triggering a JSONL dump) and ``/explain`` (per-app doctor
-    report, the same pure fold as ``PipeGraph.explain()`` and the
-    doctor CLI), and the JSON state at ``/apps`` (and any other path,
-    kept permissive for curl users)."""
+    the registered-apps index at ``/index`` (one row per app with its
+    per-app links, so a multi-tenant operator discovers tenants
+    without knowing names a priori), the OpenMetrics text exposition
+    at ``/metrics`` (telemetry/metrics.py -- point a Prometheus
+    scraper here and every traced graph's counters and latency
+    histograms come along), the diagnosis surfaces at ``/flight``
+    (per-app FlightRecorder ring, as shipped inside the monitor
+    reports -- reachable without a stall or crash triggering a JSONL
+    dump) and ``/explain`` (per-app doctor report, the same pure fold
+    as ``PipeGraph.explain()`` and the doctor CLI), the serving
+    plane's ``/tenants`` view (per-app ``Tenant`` blocks, plus the
+    hosting Server's Tenants block when ``server`` is given), and the
+    JSON state at ``/apps`` (and any other path, kept permissive for
+    curl users).  ``/apps``, ``/explain`` and ``/flight`` accept an
+    ``?app=<id>`` filter.  ``port=0`` binds an ephemeral port (read it
+    back from ``httpd.server_address``)."""
 
     class Handler(BaseHTTPRequestHandler):
+        def _filtered(self):
+            """Dashboard snapshot, narrowed by ?app=<id> when given."""
+            from urllib.parse import parse_qs, urlsplit
+            snap = dash.snapshot()
+            qs = parse_qs(urlsplit(self.path).query)
+            wanted = qs.get("app")
+            if wanted:
+                snap = {aid: app for aid, app in snap.items()
+                        if str(aid) in wanted}
+            return snap
+
         def do_GET(self):
             path = self.path.split("?", 1)[0]
-            if self.path in ("/", "/index.html"):
+            if path in ("/", "/index.html"):
                 from .webui import HTML_PAGE
                 body = HTML_PAGE.encode()
                 ctype = "text/html; charset=utf-8"
+            elif path == "/index":
+                # registered-apps index: discovery endpoint for
+                # multi-tenant operators -- every app with its name,
+                # tenant identity (when served) and per-app links
+                snap = dash.snapshot()
+                out = {}
+                for aid, app in sorted(snap.items(),
+                                       key=lambda kv: str(kv[0])):
+                    if not isinstance(app, dict):
+                        continue
+                    rep = app.get("report") or {}
+                    out[str(aid)] = {
+                        "graph": rep.get("PipeGraph_name"),
+                        "active": bool(app.get("active")),
+                        "tenant": rep.get("Tenant"),
+                        "links": {
+                            "apps": f"/apps?app={aid}",
+                            "explain": f"/explain?app={aid}",
+                            "flight": f"/flight?app={aid}",
+                            "metrics": "/metrics",
+                        },
+                    }
+                body = json.dumps(out).encode()
+                ctype = "application/json"
+            elif path == "/tenants":
+                # serving plane: per-app Tenant blocks (+ the hosting
+                # Server's own Tenants view when one is attached)
+                snap = dash.snapshot()
+                tenants = {}
+                for aid, app in sorted(snap.items(),
+                                       key=lambda kv: str(kv[0])):
+                    if not isinstance(app, dict):
+                        continue
+                    rep = app.get("report") or {}
+                    if rep.get("Tenant"):
+                        tenants[str(aid)] = dict(
+                            rep["Tenant"],
+                            graph=rep.get("PipeGraph_name"),
+                            active=bool(app.get("active")))
+                out = {"apps": tenants}
+                if server is not None:
+                    out["server"] = server.stats()
+                body = json.dumps(out).encode()
+                ctype = "application/json"
             elif path == "/metrics":
                 from ..telemetry.metrics import (CONTENT_TYPE,
                                                  render_openmetrics)
                 body = render_openmetrics(dash.snapshot()).encode()
                 ctype = CONTENT_TYPE
             elif path == "/flight":
-                snap = dash.snapshot()
+                snap = self._filtered()
                 body = json.dumps({
                     str(aid): (app.get("report") or {}).get("Flight") or []
                     for aid, app in snap.items()
@@ -170,7 +232,7 @@ def serve_http(dash: DashboardServer, port: int = 20208):
                 ctype = "application/json"
             elif path == "/explain":
                 from ..diagnosis.report import build_report
-                snap = dash.snapshot()
+                snap = self._filtered()
                 out = {}
                 for aid, app in snap.items():
                     if isinstance(app, dict) and app.get("report"):
@@ -178,7 +240,7 @@ def serve_http(dash: DashboardServer, port: int = 20208):
                 body = json.dumps(out).encode()
                 ctype = "application/json"
             else:
-                body = json.dumps(dash.snapshot()).encode()
+                body = json.dumps(self._filtered()).encode()
                 ctype = "application/json"
             self.send_response(200)
             self.send_header("Content-Type", ctype)

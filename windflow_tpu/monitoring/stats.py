@@ -28,10 +28,13 @@ from ..telemetry.histogram import LogHistogram
 # per-edge wire delivery books; distributed/observe.py merges them).
 # 6 = adds the optional Slo block (burn-rate tracker gauges,
 # slo/plane.py) and the Pool block (ColumnPool arena occupancy).
+# 7 = adds the optional Tenant block (serving plane identity: name,
+# state, priority/weight, live credit lease, arbitration count --
+# serving/server.py publishes it per tenant graph).
 # Readers (doctor CLI, dashboard /explain, tests) must tolerate MISSING
 # blocks rather than dispatch on this number: older dumps carry no
 # version field at all, and every block is optional by contract.
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 
 
 @dataclass
@@ -257,6 +260,11 @@ class GraphStats:
         # pressure evidence for the SLO/doctor surfaces)
         self.slo: Optional[dict] = None
         self.pool: Optional[dict] = None
+        # serving plane (serving/; docs/SERVING.md): this graph's
+        # tenant identity under a multi-tenant Server -- name, state,
+        # priority/weight standing, live credit lease, arbitration
+        # count; None outside a served run
+        self.tenant: Optional[dict] = None
 
     def register(self, operator_name: str, replica_id: str) -> StatsRecord:
         rec = StatsRecord(operator_name, replica_id)
@@ -351,6 +359,13 @@ class GraphStats:
         with self.lock:
             self.pool = block
 
+    def set_tenant(self, block: Optional[dict]) -> None:
+        """Publish the serving plane's tenant identity block
+        (serving/server.py, at submit and on every state/lease
+        change)."""
+        with self.lock:
+            self.tenant = block
+
     def to_json(self, dropped_tuples: int = 0,
                 dead_letter_tuples: int = 0,
                 flight_events: Optional[List[dict]] = None) -> str:
@@ -392,6 +407,7 @@ class GraphStats:
             wire = self.wire
             slo = self.slo
             pool = self.pool
+            tenant = self.tenant
             latency_e2e = None
             trace_records: List[dict] = []
             if self.histograms:
@@ -476,6 +492,10 @@ class GraphStats:
             # rides next to it as memory-pressure evidence.
             "Slo": slo,
             "Pool": pool,
+            # serving plane (serving/; docs/SERVING.md): tenant
+            # identity + live lease under a multi-tenant Server; None
+            # outside a served run
+            "Tenant": tenant,
             "Memory_usage_KB": get_mem_usage_kb(),
             "Operator_number": len(ops),
             "Operators": ops,

@@ -320,11 +320,40 @@ function opRow(op) {
     <td>${fmt(sum("Bytes_from_device"))}</td></tr>`;
 }
 
+// serving plane: tenants index (one row per tenant-carrying app, the
+// multi-tenant operator's discovery view; /tenants serves the JSON)
+function tenantsIndex(apps) {
+  const rows = Object.keys(apps).filter(id =>
+    ((apps[id] || {}).report || {}).Tenant);
+  if (!rows.length) return "";
+  let s = `<div class="app"><h2>tenants</h2>
+    <span class="badge live">${rows.length} registered</span>
+    <table><thead><tr><th>tenant</th><th>graph</th><th>state</th>
+    <th>priority</th><th>weight</th><th>credits</th>
+    <th>arbitrations</th><th>slo</th><th>links</th></tr></thead><tbody>`;
+  for (const id of rows) {
+    const a = apps[id], rep = a.report || {}, t = rep.Tenant || {};
+    const slo = rep.Slo;
+    const sloTxt = !slo ? "\\u2013"
+      : slo.Breached ? "\\u2715 breached" : "\\u2713 in SLO";
+    s += `<tr><td>${esc(t.Name)}</td>
+      <td>${esc(rep.PipeGraph_name || "")}</td>
+      <td>${esc(t.State || (a.active ? "RUNNING" : "ended"))}</td>
+      <td>${num(t.Priority)}</td><td>${num(t.Weight)}</td>
+      <td>${fmt(t.Credits)}</td><td>${num(t.Arbitrations)}</td>
+      <td>${sloTxt}</td>
+      <td><a href="/explain?app=${esc(id)}">explain</a>
+        <a href="/flight?app=${esc(id)}">flight</a>
+        <a href="/apps?app=${esc(id)}">stats</a></td></tr>`;
+  }
+  return s + "</tbody></table></div>";
+}
+
 function render(apps) {
   const root = document.getElementById("apps");
   const ids = Object.keys(apps);
   if (!ids.length) return;
-  root.innerHTML = ids.map(id => {
+  root.innerHTML = tenantsIndex(apps) + ids.map(id => {
     const a = apps[id], rep = a.report || {};
     const ops = rep.Operators || [];
     const outputs = ops.length ?          // sink row: results RECEIVED
@@ -340,6 +369,9 @@ function render(apps) {
       <h2>#${esc(id)} ${esc(rep.PipeGraph_name || "(no report yet)")}</h2>
       <span class="badge ${a.active ? "live" : "ended"}">
         ${a.active ? "\\u25cf live" : "\\u25a0 ended"}</span>
+      ${rep.Tenant ? `<span class="badge live">tenant
+        ${esc(rep.Tenant.Name)} p${num(rep.Tenant.Priority)}
+        ${fmt(rep.Tenant.Credits)}cr</span>` : ""}
       <div class="tiles">
         <div class="tile"><div class="v">${fmt(rate)}/s</div>
           <div class="k">result rate at sink</div></div>

@@ -1060,10 +1060,18 @@ class SourceLoopLogic(NodeLogic):
     (durability/barrier.py, attached by the EpochCoordinator) injects
     aligned epoch barriers at the same boundaries -- BEFORE the pause
     gate, so an epoch held open can never deadlock against a parked
-    source (PipeGraph.quiesce drains epochs before pausing)."""
+    source (PipeGraph.quiesce drains epochs before pausing).
+    ``cancel_token`` (attached by PipeGraph.start) is checked at the
+    same boundary: an unfused source learns of cancellation from its
+    poisoned outlet channel, but a FULLY fused source->...->sink chain
+    owns no channel at all, so without this check its replica thread
+    would spin forever after cancel() -- the exact leak the serving
+    plane's lifecycle census caught (repeated submit/evict of an
+    endless fused tenant stranded one thread per cycle)."""
 
     pause_control = None
     epoch_injector = None
+    cancel_token = None
 
     def __init__(self, step: Callable[[Callable[[Any], None]], bool]):
         self.step = step
@@ -1073,6 +1081,9 @@ class SourceLoopLogic(NodeLogic):
 
     def eos_flush(self, emit):
         while True:
+            tok = self.cancel_token
+            if tok is not None and tok.cancelled:
+                raise GraphCancelled("source cancelled")
             inj = self.epoch_injector
             if inj is not None:
                 inj.maybe_inject()

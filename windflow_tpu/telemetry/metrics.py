@@ -269,6 +269,40 @@ def render_openmetrics(apps: dict) -> str:
         if slo:
             out.append(f"windflow_slo_breaches_total{_labels(**lab)} "
                        f"{int(slo.get('Breaches_total', 0) or 0)}")
+    # serving plane (serving/; docs/SERVING.md): per-tenant identity +
+    # live lease -- absent entirely outside a multi-tenant Server
+    def per_tenant():
+        for rep, lab in per_graph():
+            t = rep.get("Tenant")
+            if t:
+                yield t, dict(lab, tenant=t.get("Name", ""))
+
+    family("windflow_tenant_up", "gauge",
+           "1 while the tenant's graph is RUNNING under its server")
+    for t, lab in per_tenant():
+        out.append(f"windflow_tenant_up{_labels(**lab)} "
+                   f"{1 if t.get('State') == 'RUNNING' else 0}")
+    family("windflow_tenant_credits", "gauge",
+           "live ingest-credit lease under the server's global cap")
+    for t, lab in per_tenant():
+        out.append(f"windflow_tenant_credits{_labels(**lab)} "
+                   f"{int(t.get('Credits', 0) or 0)}")
+    family("windflow_tenant_priority", "gauge",
+           "arbiter standing: higher = protected longer")
+    for t, lab in per_tenant():
+        out.append(f"windflow_tenant_priority{_labels(**lab)} "
+                   f"{int(t.get('Priority', 0) or 0)}")
+    family("windflow_tenant_weight", "gauge",
+           "arbiter tie-break inside one priority class")
+    for t, lab in per_tenant():
+        out.append(f"windflow_tenant_weight{_labels(**lab)} "
+                   f"{float(t.get('Weight', 0) or 0)}")
+    family("windflow_tenant_arbitrations", "counter",
+           "arbitration decisions this tenant was part of "
+           "(victim or donor)")
+    for t, lab in per_tenant():
+        out.append(f"windflow_tenant_arbitrations_total{_labels(**lab)} "
+                   f"{int(t.get('Arbitrations', 0) or 0)}")
     # ColumnPool arena occupancy (memory-pressure evidence next to
     # windflow_memory_bytes)
     family("windflow_pool_bytes", "gauge",
