@@ -1295,6 +1295,205 @@ def run_distributed_shuffle(n_events):
     return rate_2p, rate_1p, conserved, summary
 
 
+def run_resident_state(n_events, win=4096, slide=16, n_keys=8,
+                       source_batch=65536):
+    """Config #15_resident_state: the resident-vs-rebuild A/B on a
+    sliding-window config (docs/PLANNER.md "Resident state").  The
+    same integer-valued keyed stream runs through
+
+    * the REBUILD lane: ``WinSeqTPU`` with an ffat kind -- every
+      launch re-stages the whole retained per-key series and rebuilds
+      the device tree (win_seqffat_gpu.hpp rebuild=true);
+    * the RESIDENT lane: ``WinSeqFFATResident`` -- the per-key forest
+      stays in device memory as donated jit carry and each launch
+      ships only the new leaves + fired results (rebuild=false).
+
+    Results are asserted IDENTICAL (integer f32 sums are exact), and
+    the report carries both lanes' ``Device_bytes_per_launch`` plus
+    the shipped-bytes ratio (the >=10x acceptance claim) and the
+    resident lane's state-bytes gauge and window-latency p50/p99."""
+    import jax.numpy as jnp
+    import windflow_tpu as wf
+    from windflow_tpu.core.tuples import TupleBatch
+    from windflow_tpu.operators.basic_ops import Sink
+    from windflow_tpu.operators.batch_ops import BatchSource
+    from windflow_tpu.operators.tpu.ffat_resident import \
+        WinSeqFFATResident
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
+
+    def lane(make_op):
+        stamps = []
+        state = {"i": 0}
+
+        def batch():
+            i = state["i"]
+            if i >= n_events:
+                return None
+            state["i"] = i + source_batch
+            stamps.append(time.perf_counter())
+            idx = np.arange(i, min(i + source_batch, n_events))
+            return TupleBatch({
+                "key": idx % n_keys, "id": idx // n_keys,
+                "ts": idx // n_keys,
+                "value": (idx % 97).astype(np.float64)})
+
+        results = {}
+        lats = []
+        lock = threading.Lock()
+
+        def sink(r):
+            if r is None:
+                return
+            now = time.perf_counter()
+            with lock:
+                results[(r.key, r.id)] = r.value
+                # closing tuple of CB window w is id w*slide+win-1 of
+                # its key = global event (id*n_keys + key)
+                closing = (r.id * slide + win - 1) * n_keys + r.key
+                ci = min(closing // source_batch, len(stamps) - 1)
+                if ci >= 0:
+                    lats.append(now - stamps[ci])
+        g = wf.PipeGraph("bench15", wf.Mode.DEFAULT)
+        g.add_source(BatchSource(batch)).add(make_op()) \
+            .add_sink(Sink(sink))
+        t0 = time.perf_counter()
+        g.run()
+        dt = time.perf_counter() - t0
+        bpl = resident_bytes = 0
+        rep = json.loads(g.stats.to_json())
+        for o in rep["Operators"]:
+            for r in o["Replicas"]:
+                if r.get("Device_bytes_per_launch"):
+                    bpl = r["Device_bytes_per_launch"]
+                    resident_bytes = r.get(
+                        "Device_state_bytes_resident", 0)
+        return n_events / dt, results, lats, bpl, resident_bytes
+
+    rb_rate, rb_res, rb_lats, rb_bpl, _ = lane(
+        lambda: WinSeqTPU(("ffat", jnp.add, 0.0), win, slide,
+                          wf.WinType.CB, batch_len=128,
+                          max_buffer_elems=MAX_BUFFER,
+                          inflight_depth=INFLIGHT))
+    rs_rate, rs_res, rs_lats, rs_bpl, rs_state = lane(
+        lambda: WinSeqFFATResident(lambda t: t.value, jnp.add, 0.0,
+                                   win, slide, wf.WinType.CB))
+    assert rb_res == rs_res, (
+        f"resident lane diverged from rebuild: "
+        f"{len(rb_res)} vs {len(rs_res)} windows")
+    assert rb_bpl and rs_bpl, "device byte accounting missing"
+    return {
+        "rebuild": {"rate": round(rb_rate, 1),
+                    "bytes_per_launch": rb_bpl},
+        "resident": {"rate": round(rs_rate, 1),
+                     "bytes_per_launch": rs_bpl,
+                     "state_bytes_resident": rs_state},
+        "bytes_ratio": round(rb_bpl / rs_bpl, 1),
+        "windows": len(rs_res),
+        "lats": (rb_lats, rs_lats),
+    }
+
+
+def run_replan_shift(n_events=1_200_000, source_batch=1500,
+                     pace_s=0.004):
+    """Config #15_replan_shift: the scripted load shift
+    (docs/PLANNER.md "online re-planning").  The cost model is pinned
+    (tiny RTT floor, fixed host rate, no compute calibration) so the
+    start-time planner resolves the engine onto 'device'; the
+    measured per-launch walls of the paced stream then contradict the
+    free-compute projection -- the exact cpu-fallback failure mode of
+    the PR 6 MEASURED note -- and the online re-planner flips the
+    lane device->host mid-run through the quiesce path.  Asserts the
+    flip happened with zero lost/duplicated windows (ledger balanced)
+    and returns the flip evidence + flip wall time."""
+    import windflow_tpu as wf
+    from windflow_tpu.core.basic import RuntimeConfig
+    from windflow_tpu.core.tuples import TupleBatch
+    from windflow_tpu.operators.basic_ops import Sink
+    from windflow_tpu.operators.batch_ops import BatchSource
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
+
+    n_keys, win, slide = 4, 1024, 32
+    pinned = {"WINDFLOW_RTT_FLOOR_MS": "0.001",
+              "WINDFLOW_HOST_RATE_TPS": "20000000",
+              "WINDFLOW_DEVICE_COMPUTE_MS": "0"}
+    saved = {k: os.environ.get(k) for k in pinned}
+    os.environ.update(pinned)
+    try:
+        cfg = RuntimeConfig(mode=wf.Mode.DEFAULT, replan=True,
+                            replan_ticks=2, diagnosis_interval_s=0.15,
+                            audit_interval_s=0.1)
+        g = wf.PipeGraph("bench15r", wf.Mode.DEFAULT, cfg)
+        state = {"i": 0, "tail": 0}
+
+        def batch():
+            # the paced stream keeps flowing until the flip lands
+            # (plus a short post-flip tail), bounded by n_events --
+            # robust to a warm/loaded box where the hysteresis takes
+            # a variable number of ticks
+            i = state["i"]
+            if any(e["kind"] == "replacement"
+                   for e in g.flight.snapshot()):
+                state["tail"] += 1
+            if i >= n_events or state["tail"] > 25:
+                return None
+            state["i"] = i + source_batch
+            time.sleep(pace_s)
+            idx = np.arange(i, i + source_batch)
+            return TupleBatch({
+                "key": idx % n_keys, "id": idx // n_keys,
+                "ts": idx // n_keys,
+                "value": (idx % 7).astype(np.float64)})
+
+        counts = {}
+        lock = threading.Lock()
+
+        def sink(r):
+            if r is None:
+                return
+            with lock:
+                counts[(r.key, r.id)] = counts.get((r.key, r.id),
+                                                   0) + 1
+        op = WinSeqTPU("sum", win, slide, wf.WinType.CB, batch_len=64,
+                       inflight_depth=1, placement="auto",
+                       value_of=lambda t: t.value)
+        g.add_source(BatchSource(batch)).add(op).add_sink(Sink(sink))
+        t0 = time.perf_counter()
+        g.run()
+        dt = time.perf_counter() - t0
+        flips = [e for e in g.flight.snapshot()
+                 if e["kind"] == "replacement"]
+        assert flips, "re-planner never flipped the lane"
+        assert not [e for e in g.flight.snapshot()
+                    if e["kind"] == "conservation_violation"], \
+            "ledger unbalanced across the flip"
+        fed = state["i"]
+        per_key = fed // n_keys
+        expect = 0
+        w = 0
+        while w * slide < per_key:
+            expect += n_keys
+            w += 1
+        assert len(counts) == expect and \
+            max(counts.values()) == 1, "lost/duplicated windows"
+        return {
+            "rate": round(fed / dt, 1),
+            "events": fed,
+            "windows": len(counts),
+            "flip": {k: flips[0].get(k) for k in
+                     ("operator", "old", "new", "trigger",
+                      "duration_ms")},
+            "evidence": flips[0].get("evidence"),
+            "placement": next(p["placement"] for p in g.placements
+                              if "win_seq_tpu" in p["operator"]),
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def run_reference_arch_baseline(n_events):
     """The honest baseline: identical workload through the native C++
     record-at-a-time engine in the reference's architecture (one thread
@@ -1630,6 +1829,21 @@ def main():
         "records": sum(t["records"] for t in tenants14),
         "per_tenant": tenants14,
         **mt14}
+    # resident-state lane (docs/PLANNER.md "Resident state"): the
+    # >=10x bytes/launch claim, asserted from Device_bytes_per_launch
+    # with results identical between lanes, plus the scripted
+    # load-shift replan flip
+    r15 = run_resident_state(N_EVENTS // 8)
+    rb_lats, rs_lats = r15.pop("lats")
+    p50rb, p99rb = _pcts(rb_lats)
+    p50rs, p99rs = _pcts(rs_lats)
+    assert r15["bytes_ratio"] >= 10, \
+        f"resident bytes/launch ratio {r15['bytes_ratio']} < 10x"
+    r15["rebuild"]["p50_ms"], r15["rebuild"]["p99_ms"] = p50rb, p99rb
+    r15["resident"]["p50_ms"], r15["resident"]["p99_ms"] = p50rs, p99rs
+    configs["15_resident_state"] = {"rate": r15["resident"]["rate"],
+                                    **r15}
+    configs["15_replan_shift"] = run_replan_shift()
     for name, c in configs.items():
         n_out = c.get("windows", c.get("records", 0))
         print(f"[bench] {name}: {c['rate']:,.0f} tuples/s "

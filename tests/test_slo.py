@@ -697,7 +697,7 @@ REPORT_KEYS = {
     "Graph", "Schema_version", "Verdict", "Bottleneck", "Attribution",
     "Anomalies", "Anomalies_total", "Slo", "Conservation",
     "Durability", "Hot_keys", "History", "Failures", "Arbitrations",
-    "Flight_tail",
+    "Replacements", "Flight_tail",
 }
 
 

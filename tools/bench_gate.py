@@ -179,6 +179,27 @@ def measure() -> tuple:
     lats["14_multitenant_contention"] = (
         {"p50_ms": max(t.get("p50_ms") or 0 for t in qual),
          "p99_ms": max(t["p99_ms"] for t in qual)} if qual else None)
+    # resident-state smoke (docs/PLANNER.md "Resident state"): the
+    # helper itself asserts the two lanes' results identical; the
+    # gate additionally holds the >=10x bytes/launch acceptance ratio
+    # and gates the resident lane's rate + latency
+    r15 = bench.run_resident_state(N_SMALL)
+    rb15, rs15 = r15.pop("lats")
+    assert r15["bytes_ratio"] >= 10, \
+        f"resident bytes ratio {r15['bytes_ratio']} < 10x"
+    out["15_resident_state"] = r15["resident"]["rate"]
+    out["15_rebuild_state"] = r15["rebuild"]["rate"]
+    if rs15:
+        import numpy as _np
+        lats["15_resident_state"] = {
+            "p50_ms": round(float(_np.percentile(rs15, 50)) * 1e3, 2),
+            "p99_ms": round(float(_np.percentile(rs15, 99)) * 1e3, 2)}
+    # scripted load-shift replan smoke: the helper asserts the lane
+    # flipped mid-run with zero lost/duplicated windows and a
+    # balanced ledger; the gated rate catches a wedged flip path
+    r15r = bench.run_replan_shift()
+    assert r15r["placement"] == "host", "replan flip did not land"
+    out["15_replan_shift"] = r15r["rate"]
     return out, {k: v for k, v in lats.items() if v}
 
 

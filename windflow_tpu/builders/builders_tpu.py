@@ -15,6 +15,7 @@ from typing import Any, Callable
 from ..operators.tpu.farms_tpu import (KeyFarmTPU, KeyFFATTPU, PaneFarmTPU,
                                        WinFarmTPU, WinMapReduceTPU,
                                        WinSeqFFATTPU)
+from ..core.basic import WinType
 from ..operators.tpu.win_seq_tpu import (DEFAULT_BATCH_LEN,
     DEFAULT_INFLIGHT_DEPTH, DEFAULT_MAX_BATCH_DELAY_MS,
     DEFAULT_MAX_BUFFER_ELEMS, WinSeqTPU)
@@ -27,10 +28,23 @@ class _TPUBuilderMixin:
     max_batch_delay_ms = DEFAULT_MAX_BATCH_DELAY_MS
     placement = "device"
     adaptive_batch = False
+    resident = None
 
     def with_batch(self, batch_len: int):
         self.batch_len = batch_len
         return self
+
+    def with_resident(self, on=True):
+        """Resident pane-partial state (docs/PLANNER.md "Resident
+        state"): per-key window carry stays device-resident across
+        launches and only new partials ship.  True forces the resident
+        lane (rejecting ineligible shapes loudly), False opts out;
+        the default (None) lets the placement planner promote
+        eligible device-lane engines automatically."""
+        self.resident = on
+        return self
+
+    withResident = with_resident
 
     def with_placement(self, placement: str):
         """Engine lane: 'device' (XLA launches -- the default, status
@@ -61,11 +75,13 @@ class _TPUBuilderMixin:
         """Builders whose operators cannot change lanes (FFAT trees,
         device MAP/REDUCE composites) reject non-default placement
         loudly instead of ignoring it."""
-        if self.placement != "device" or self.adaptive_batch:
+        if self.placement != "device" or self.adaptive_batch \
+                or self.resident is not None:
             raise ValueError(
                 f"{type(self).__name__} is device-pinned: "
-                "with_placement/with_adaptive_batch are not supported "
-                "on this operator family")
+                "with_placement/with_adaptive_batch/with_resident are "
+                "not supported on this operator family (the FFAT "
+                "family's resident mode is with_rebuild(False))")
 
     def with_max_buffer(self, elems: int):
         """Host staging-buffer capacity (elements) for the device
@@ -147,7 +163,8 @@ class WinSeqTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
                          inflight_depth=self.inflight_depth,
                          max_batch_delay_ms=self.max_batch_delay_ms,
                          placement=self.placement,
-                         adaptive_batch=self.adaptive_batch)
+                         adaptive_batch=self.adaptive_batch,
+                         resident=self.resident)
 
 
 @_alias_camel
@@ -317,16 +334,24 @@ class WinSeqFFATTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
         self.combine = combine
         self.batch_len = DEFAULT_BATCH_LEN
         self.device_index = 0
-        self.rebuild = True
+        # None = auto (docs/PLANNER.md "Resident state"): CB windows
+        # default onto the RESIDENT lane (rebuild=False) -- per-key
+        # forests stay in HBM across launches and only new leaves
+        # ship; TB windows default to rebuild (the resident ring's
+        # eviction proof needs per-key in-order timestamps, which an
+        # arbitrary TB stream does not guarantee)
+        self.rebuild = None
 
     def with_rebuild(self, rebuild: bool):
-        """rebuild=True (default): the tree is rebuilt from the staged
-        flat buffer every device launch.  rebuild=False: the per-key
-        forest stays resident in HBM and is incrementally updated (the
-        Win_SeqFFAT_GPU ``rebuild`` flag, win_seqffat_gpu.hpp:150).
-        CB windows ride the arrival-order leaf ring; TB windows need
-        per-key in-order timestamps (ring eviction is keyed on the
-        timestamp proof) -- out-of-order TB streams use rebuild=True."""
+        """rebuild=True: the tree is rebuilt from the staged flat
+        buffer every device launch.  rebuild=False: the per-key forest
+        stays resident in HBM and is incrementally updated (the
+        Win_SeqFFAT_GPU ``rebuild`` flag, win_seqffat_gpu.hpp:150) --
+        the DEFAULT for CB windows.  CB windows ride the arrival-order
+        leaf ring; TB windows need per-key in-order timestamps (ring
+        eviction is keyed on the timestamp proof), so out-of-order TB
+        streams must keep rebuild=True (the TB default; rebuild=False
+        opts an in-order TB stream in)."""
         self.rebuild = rebuild
         return self
 
@@ -348,7 +373,17 @@ class WinSeqFFATTPUBuilder(_WinBuilderBase, _TPUBuilderMixin):
     def build(self):
         self._check_windows()
         self._check_placement_supported()
-        if not self.rebuild:
+        rebuild = self.rebuild
+        if rebuild is None:
+            # auto: CB engines default onto the resident lane when the
+            # combine has a resident form; TB (ordering not guaranteed)
+            # and exotic combines keep the rebuild path
+            try:
+                self._resident_combine()
+                rebuild = self.win_type != WinType.CB
+            except ValueError:
+                rebuild = True
+        if not rebuild:
             from ..operators.tpu.ffat_resident import WinSeqFFATResident
             fn, neutral = self._resident_combine()
             return WinSeqFFATResident(self.fn, fn, neutral, self.win_len,

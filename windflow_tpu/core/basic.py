@@ -300,6 +300,21 @@ class RuntimeConfig:
     # deletes the oldest); <= 0 keeps every file (the pre-rotation
     # behaviour)
     snapshot_keep: int = 16
+    # -- online re-planning (graph/replanner.py; docs/PLANNER.md
+    # "Resident state & online re-planning") ----------------------------
+    # The start-time placement decision becomes a running hypothesis:
+    # a re-planner riding the diagnosis tick compares each auto-placed
+    # window engine's MEASURED per-launch wall (and its attribution
+    # split into device transport vs compute) against the cost model's
+    # projection, and when they contradict it for ``replan_ticks``
+    # consecutive ticks, swaps that engine's lane device<->host mid-run
+    # through the quiesce/migrate path with zero lost tuples -- a
+    # ``replacement`` flight event doctor explains.  Off by default:
+    # flipping lanes mid-run trades determinism of the operating point
+    # for adaptivity, which is an operator's call.
+    replan: bool = False
+    # consecutive contradicting diagnosis ticks before a lane flip
+    replan_ticks: int = 3
     # -- elastic scaling plane (elastic/; docs/ELASTIC.md) --------------
     # elastic.controller.ElasticityConfig tuning the load-driven
     # controller (sample period, EWMA alpha, cooldown, hysteresis,

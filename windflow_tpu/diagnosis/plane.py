@@ -265,6 +265,13 @@ class DiagnosisPlane:
         self._scores = {r["operator"]: r["score"]
                         for r in bottleneck.get("Sinks", [])
                         if r.get("operator")}
+        # online re-planner (graph/replanner.py): decision-only here --
+        # measures launch deltas and queues any lane flip onto its own
+        # worker thread (a flip quiesces the graph for seconds and
+        # must not stall this cadence)
+        rp = getattr(g, "replanner", None)
+        if rp is not None:
+            rp.tick()
         self.ticks += 1
         block = {
             "Ticks": self.ticks,

@@ -99,6 +99,16 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
         "detail": e.get("detail"),
         "evidence": e.get("evidence"),
     } for e in flight if e.get("kind") == "arbitration"]
+    # online re-planning (graph/replanner.py; docs/PLANNER.md): lane
+    # flips with the measured evidence that forced them
+    replacements = [{
+        "t": e.get("t"),
+        "operator": e.get("operator"),
+        "old": e.get("old"),
+        "new": e.get("new"),
+        "trigger": e.get("trigger"),
+        "evidence": e.get("evidence"),
+    } for e in flight if e.get("kind") == "replacement"]
     dur = stats.get("Durability")
     durability = None
     if dur:
@@ -126,6 +136,7 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
         "History": history,
         "Failures": failures,
         "Arbitrations": arbitrations[-FLIGHT_TAIL:],
+        "Replacements": replacements[-FLIGHT_TAIL:],
         "Flight_tail": list(flight)[-FLIGHT_TAIL:],
     }
     report["Verdict"] = _verdict(report)
@@ -305,6 +316,22 @@ def render_text(report: dict) -> str:
                    f"{a.get('victim')}: {a.get('action')}"
             if a.get("detail"):
                 line += f": {a['detail']}"
+            out.append(line)
+    reps = report.get("Replacements") or []
+    if reps:
+        out.append("")
+        out.append("lane replacements (online re-planning):")
+        for r in reps:
+            line = f"  [{r.get('t')}] {r.get('operator')}: " \
+                   f"{r.get('old')} -> {r.get('new')} " \
+                   f"({r.get('trigger')})"
+            ev = r.get("evidence") or {}
+            if ev.get("measured_ms") is not None:
+                line += (f": measured {ev['measured_ms']} ms/launch vs "
+                         f"rtt floor {ev.get('rtt_floor_ms')} ms, "
+                         f"projected device "
+                         f"{ev.get('device_rate_tps')} t/s vs host "
+                         f"{ev.get('host_rate_tps')} t/s")
             out.append(line)
     hot = report.get("Hot_keys") or []
     if hot:

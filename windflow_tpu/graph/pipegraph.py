@@ -99,6 +99,9 @@ class PipeGraph:
         # start() when RuntimeConfig.distributed is set
         self._dist_plan = None
         self._dist = None
+        # online re-planner (graph/replanner.py; docs/PLANNER.md):
+        # built at start() when RuntimeConfig.replan is on
+        self.replanner = None
 
     # -- construction ------------------------------------------------------
     def _new_pipe(self) -> MultiPipe:
@@ -294,6 +297,18 @@ class PipeGraph:
         self.placements = plan_graph(self)
         for d in self.placements:
             self.flight.record("placement", **d)
+        # online re-planning (graph/replanner.py; docs/PLANNER.md):
+        # the start-time decision becomes a running hypothesis -- a
+        # re-planner riding the diagnosis tick flips a lane mid-run
+        # when the measured launch walls contradict the projection
+        if self.config.replan and self.placements:
+            if not self.config.diagnosis:
+                raise RuntimeError(
+                    "RuntimeConfig.replan needs the diagnosis plane: "
+                    "re-planning rides the diagnosis tick (leave "
+                    "RuntimeConfig.diagnosis at its default True)")
+            from .replanner import RePlanner
+            self.replanner = RePlanner(self)
         # attach the column pool to every node and emitter (pooled
         # materialization + partition sub-batches)
         if self.buffer_pool is not None:
@@ -468,6 +483,8 @@ class PipeGraph:
     def wait_end(self) -> None:
         errors, stuck = self._join_all()
         self._ended = True
+        if self.replanner is not None:
+            self.replanner.stop()
         if self._dist is not None:
             # distributed plane: flush the wire tails (acks settle the
             # senders' replay buffers, so the ledger closes over the
@@ -728,6 +745,72 @@ class PipeGraph:
             self.flight.record("rescale", **event.to_dict())
         return event
 
+    # -- online re-planning (graph/replanner.py; docs/PLANNER.md) -------
+    def replace_lane(self, operator: str, lane: str,
+                     trigger: str = "manual", timeout: float = 60.0,
+                     evidence: Optional[dict] = None):
+        """Flip a placed window engine's lane device<->host mid-run
+        with zero lost tuples: serialize with elastic rescales under
+        the rescale lock, hold the epoch cadence (a flip between two
+        epochs restores exactly-once, like a rescale), drain the
+        pipeline to a quiescent cut -- channels empty, no device
+        batches in flight -- then swap the engine and resume.  Keyed
+        window state lives in the host staging store on both lanes
+        (resident device state is derivable from it and dropped on a
+        host flip), so the swap migrates nothing and loses nothing.
+
+        Records a ``replacement`` flight event the doctor explains.
+        Returns the event dict, or None when already on ``lane``."""
+        if lane not in ("device", "host"):
+            raise ValueError(f"lane must be 'device' or 'host', "
+                             f"not {lane!r}")
+        if not self._started:
+            raise RuntimeError("replace_lane() needs a started graph")
+        if self._ended:
+            raise RuntimeError("replace_lane() after wait_end()")
+        target = None
+        for name, logic, _entry in getattr(self, "placed_engines", []):
+            if name == operator:
+                target = logic
+                break
+        if target is None:
+            raise KeyError(
+                f"no placed window engine named {operator!r}; placed: "
+                f"{sorted(n for n, _l, _e in getattr(self, 'placed_engines', []))}")
+        old = target.resolved_placement
+        if old == lane:
+            return None
+        dur = self.durability
+        if dur is not None:
+            dur.hold_epochs(timeout)
+        t0 = _time.monotonic()
+        try:
+            with self._rescale_lock:
+                self.quiesce(timeout)
+                try:
+                    target.apply_placement(lane)
+                    if lane == "device":
+                        # re-promote eligible engines onto the
+                        # resident lane (the host flip dropped it)
+                        maybe = getattr(target,
+                                        "maybe_enable_resident", None)
+                        if maybe is not None:
+                            maybe()
+                finally:
+                    self.resume()
+            if dur is not None:
+                dur.rewire()
+        finally:
+            if dur is not None:
+                dur.release_epochs()
+        event = {"operator": operator, "old": old, "new": lane,
+                 "trigger": trigger,
+                 "duration_ms": round((_time.monotonic() - t0) * 1e3, 1)}
+        if evidence:
+            event["evidence"] = evidence
+        self.flight.record("replacement", **event)
+        return event
+
     # -- SLO plane (slo/; docs/OBSERVABILITY.md "SLO plane") ------------
     def with_slo(self, p99_ms: Optional[float] = None,
                  min_throughput_rps: Optional[float] = None,
@@ -776,6 +859,20 @@ class PipeGraph:
                 # (runtime/queues.py:73 / native.py:209), exported here
                 rec.queue_high_watermark = getattr(ch,
                                                    "high_watermark", 0)
+            # resident-lane gauge (docs/PLANNER.md "Resident state"):
+            # bytes of per-key window state living in device memory --
+            # every fused segment's engine reports into its own record
+            pairs = ([(seg.logic, seg.stats)
+                      for seg in n.logic.segments]
+                     if isinstance(n.logic, FusedLogic)
+                     else [(logic, rec)])
+            for lg, r in pairs:
+                resid = getattr(lg, "device_resident_bytes", None)
+                if resid is not None and r is not None:
+                    try:
+                        r.device_state_bytes = resid()
+                    except Exception:
+                        pass  # engine mid-swap: keep the last reading
             gate = getattr(logic, "gate", None)  # ingest source replicas
             if gate is not None:
                 wait = gate.wait_time_s

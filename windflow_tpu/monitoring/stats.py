@@ -72,6 +72,14 @@ class StatsRecord:
     # finally separates transport from compute behind the tunnel:
     # est. transport = launches x floor, est. compute = the rest.
     device_time_ms: float = 0.0
+    # resident-lane gauge (docs/PLANNER.md "Resident state"): bytes of
+    # per-key window state living in device memory ACROSS launches
+    # (FFAT forest / pane-partial rings).  Separate from the shipped
+    # byte counters above, which on the resident lane count only NEW
+    # bytes per launch (events in + results out) -- the >=10x
+    # bytes/launch claim is the ratio between the two lanes' shipped
+    # counters, measurable because state never re-ships.
+    device_state_bytes: int = 0
     # ingest-plane metrics (ingest/; zero outside ingest sources):
     # admission-shed tuples, live credit level, tuples parked in outlet
     # channels, the controller's current coalesced batch size and its
@@ -155,6 +163,8 @@ class StatsRecord:
             "Frontier": round(self.frontier, 1),
             "Frontier_lag_ms": round(self.frontier_lag_ms, 1),
         }
+        if self.device_state_bytes:
+            d["Device_state_bytes_resident"] = self.device_state_bytes
         if self.num_launches:
             # per-launch derivations + the roofline estimate: achieved
             # bytes/s over the launch wall time as a fraction of the

@@ -317,7 +317,12 @@ function opRow(op) {
     <td>${fmt(sum("Device_launches"))}</td>
     <td>${sum("Device_time_ms") ? sum("Device_time_ms").toFixed(0) : "–"}</td>
     <td>${fmt(sum("Bytes_to_device"))}</td>
-    <td>${fmt(sum("Bytes_from_device"))}</td></tr>`;
+    <td>${fmt(sum("Bytes_from_device"))}</td>
+    <td>${sum("Device_launches")
+      ? fmt(Math.round((sum("Bytes_to_device") + sum("Bytes_from_device"))
+                       / sum("Device_launches"))) : "–"}</td>
+    <td>${sum("Device_state_bytes_resident")
+      ? fmt(sum("Device_state_bytes_resident")) : "–"}</td></tr>`;
 }
 
 // serving plane: tenants index (one row per tenant-carrying app, the
@@ -446,7 +451,8 @@ function render(apps) {
         <th>ingest</th><th>svc &micro;s</th>
         <th>svc p50/p99</th><th>res p99</th>
         <th>launches</th><th>dev ms</th>
-        <th>B&rarr;dev</th><th>B&larr;dev</th></tr>
+        <th>B&rarr;dev</th><th>B&larr;dev</th>
+        <th>dev B/launch</th><th>dev B resident</th></tr>
       </thead><tbody>${ops.map(opRow).join("")}</tbody></table>
       ${skewTable(rep.Skew)}
     </div>`;

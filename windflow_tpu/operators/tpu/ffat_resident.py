@@ -35,7 +35,7 @@ from ..base import Operator, StageSpec
 
 class _ResidentKey:
     __slots__ = ("row", "count", "next_fire", "ts_ring",
-                 "ts_vals", "ts_base", "max_ts", "anchored")
+                 "ts_vals", "ts_base", "max_ts", "anchored", "dead_idx")
 
     def __init__(self, row: int, capacity: int, tb: bool = False):
         self.row = row
@@ -44,11 +44,17 @@ class _ResidentKey:
         if tb:
             # TB: host mirror of the leaf timestamps at absolute
             # positions [ts_base, count), for extent binary search and
-            # the eviction proof
+            # the eviction proof.  ``dead_idx`` is the running cursor
+            # of the fired frontier inside the mirror: the eviction
+            # proof resumes its binary search there, so each svc call
+            # scans only the mirror's NEW tail -- O(new tuples), not
+            # O(history) -- and the mirror is sliced at the cursor
+            # before it can grow past ~2x the live span
             self.ts_vals = np.empty(0, np.int64)
             self.ts_base = 0
             self.max_ts = -1
             self.anchored = False
+            self.dead_idx = 0
         else:
             # host-side timestamp ring mirroring the leaf ring, so CB
             # results carry the last-extent-tuple ts like every other
@@ -129,10 +135,34 @@ class WinSeqFFATResidentLogic(NodeLogic):
                                    old_leaves[st.row, pos % old_n])
 
     # -- ingest --------------------------------------------------------
-    def _ingest_chunk(self, rows, ids, lifted, key_objs, emit) -> None:
-        """One forest update + fire/query pass (chunk small enough that
-        no due window's leaves can be overwritten)."""
-        self.forest.update(rows, ids, lifted)
+    def _count_launch(self, new_bytes: int, res: np.ndarray) -> None:
+        """Per-launch accounting for the resident lane: only NEW bytes
+        cross the transport (lifted leaves + positions in, fired
+        results out) -- the resident forest itself never re-ships, so
+        ``Device_bytes_per_launch`` measures exactly the incremental
+        traffic, with the forest footprint on the separate
+        ``Device_state_bytes_resident`` gauge."""
+        self.launched_batches += 1
+        if self.stats is not None:
+            self.stats.num_launches += 1
+            self.stats.bytes_to_device += new_bytes
+            self.stats.bytes_from_device += res.nbytes
+            self.stats.device_state_bytes = self.forest.state_bytes
+
+    def device_resident_bytes(self) -> int:
+        """Gauge hook (monitoring/stats.py): resident forest bytes."""
+        return self.forest.state_bytes
+
+    def _ingest_chunk(self, row, start_id, lifted, key_objs,
+                      emit) -> None:
+        """One FUSED forest launch per chunk (chunk small enough that
+        no due window's leaves can be overwritten): scatter the new
+        lifted leaves, recompute their root paths and answer every due
+        window against the post-update tree -- decode -> fold ->
+        trigger in a single jitted program.  New leaves are one
+        CONSECUTIVE run per chunk, so the launch ships only the lifted
+        values + a 12-byte (row, start, len) descriptor + extents --
+        never positions, never state."""
         qk_rows: List[int] = []
         qs: List[int] = []
         qe: List[int] = []
@@ -147,16 +177,25 @@ class WinSeqFFATResidentLogic(NodeLogic):
                 qe.append(start + self.win_len)
                 meta.append((key, lwid))
                 st.next_fire += 1
-        if qk_rows:
-            self._emit_windows(qk_rows, qs, qe, meta, emit)
+        lifted = np.asarray(lifted, np.float32)
+        new_bytes = lifted.nbytes + 12 + 8 * len(qk_rows)
+        res = self.forest.update_runs_query(
+            [row], [start_id], [len(lifted)], lifted, qk_rows, qs, qe)
+        self._count_launch(new_bytes, res)
+        for (key, lwid), end, val in zip(meta, qe, res):
+            out = self.result_factory()
+            out.value = float(val)
+            # CB convention: result ts = last tuple in the extent
+            rts = int(self.keys[key].ts_ring[(end - 1)
+                                             % self.capacity])
+            out.set_control_fields(key, lwid, rts)
+            emit(out)
 
     def _emit_windows(self, rows, qs, qe, meta, emit) -> None:
+        """Query-only launch (EOS flush: no new leaves to scatter)."""
         res = self.forest.query(np.asarray(rows), np.asarray(qs),
                                 np.asarray(qe))
-        self.launched_batches += 1
-        if self.stats is not None:
-            self.stats.num_launches += 1
-            self.stats.bytes_from_device += res.nbytes
+        self._count_launch(8 * len(rows), res)
         for (key, lwid), end, val in zip(meta, qe, res):
             out = self.result_factory()
             out.value = float(val)
@@ -166,6 +205,25 @@ class WinSeqFFATResidentLogic(NodeLogic):
             emit(out)
 
     # -- TB plane: timestamp-proof ring eviction -----------------------
+    def _dead_count(self, st) -> int:
+        """Mirror index of the fired frontier: leaves below it are dead
+        (every window covering them has fired).  The binary search
+        RESUMES at the running ``dead_idx`` cursor -- the frontier is
+        monotone, so each call scans only the mirror's new tail and the
+        proof stays O(new tuples) per svc call instead of re-sweeping
+        the whole history mirror."""
+        t = st.next_fire * self.slide_len
+        st.dead_idx += int(np.searchsorted(st.ts_vals[st.dead_idx:],
+                                           t, "left"))
+        return st.dead_idx
+
+    def _pos(self, st, t: int) -> int:
+        """Absolute mirror position of the first leaf with ts >= t, for
+        t at/above the fired frontier (resumes at the cursor: every
+        leaf below it has ts < the frontier <= t)."""
+        return st.ts_base + st.dead_idx + int(np.searchsorted(
+            st.ts_vals[st.dead_idx:], t, "left"))
+
     def _ingest_tb(self, key, tss, vals, emit) -> None:
         st = self._key_state(key)
         # compare against max_ts, not the mirror tail: full mirror
@@ -190,29 +248,31 @@ class WinSeqFFATResidentLogic(NodeLogic):
             # timestamp proof: leaves with ts below the fired frontier
             # are dead (every window covering them already fired); if
             # the live span plus this chunk overflows the ring, grow it
-            dead = st.ts_base + int(np.searchsorted(
-                st.ts_vals, st.next_fire * self.slide_len, "left"))
+            dead = st.ts_base + self._dead_count(st)
             live_after = st.count + (d - c) - dead
             if live_after > self.capacity:
                 # slice every mirror to its exact dead frontier first so
                 # [ts_base, count) spans <= capacity per key and old
                 # ring positions are alias-free for the re-scatter
                 for st2 in self.keys.values():
-                    d2 = int(np.searchsorted(
-                        st2.ts_vals, st2.next_fire * self.slide_len,
-                        "left"))
+                    d2 = self._dead_count(st2)
                     st2.ts_vals = st2.ts_vals[d2:]
                     st2.ts_base += d2
+                    st2.dead_idx = 0
                 self._grow_leaves(int(live_after) + self._chunk_headroom)
             ids = np.arange(st.count, st.count + (d - c))
             st.ts_vals = np.concatenate([st.ts_vals, tss[c:d]])
             st.count += d - c
             st.max_ts = int(tss[d - 1])
-            self.forest.update(np.full(d - c, st.row), ids,
-                               vals[c:d].astype(np.float32))
-            self._fire_tb(key, st, emit)
+            # one FUSED launch: scatter the chunk's leaves (one
+            # consecutive run) + answer its due windows against the
+            # post-update forest
+            self._fire_tb(key, st, emit,
+                          update=(st.row, int(ids[0]),
+                                  vals[c:d].astype(np.float32)))
 
-    def _fire_tb(self, key, st, emit, at_eos: bool = False) -> None:
+    def _fire_tb(self, key, st, emit, at_eos: bool = False,
+                 update=None) -> None:
         rows, qs, qe, meta = [], [], [], []
         while True:
             s_ts = st.next_fire * self.slide_len
@@ -221,10 +281,8 @@ class WinSeqFFATResidentLogic(NodeLogic):
                     break
             elif st.max_ts < s_ts + self.win_len:
                 break
-            sp = st.ts_base + int(np.searchsorted(st.ts_vals, s_ts,
-                                                  "left"))
-            ep = st.ts_base + int(np.searchsorted(
-                st.ts_vals, s_ts + self.win_len, "left"))
+            sp = self._pos(st, s_ts)
+            ep = self._pos(st, s_ts + self.win_len)
             rows.append(st.row)
             qs.append(sp)
             qe.append(ep)
@@ -232,25 +290,33 @@ class WinSeqFFATResidentLogic(NodeLogic):
             meta.append((key, st.next_fire,
                          s_ts + self.win_len - 1))
             st.next_fire += 1
-        if rows:
+        res = None
+        if update is not None:
+            u_row, u_start, u_vals = update
+            new_bytes = u_vals.nbytes + 12 + 8 * len(rows)
+            res = self.forest.update_runs_query(
+                [u_row], [u_start], [len(u_vals)], u_vals, rows, qs, qe)
+            self._count_launch(new_bytes, res)
+            if not rows:
+                res = None
+        elif rows:
             res = self.forest.query(np.asarray(rows), np.asarray(qs),
                                     np.asarray(qe))
-            self.launched_batches += 1
-            if self.stats is not None:
-                self.stats.num_launches += 1
-                self.stats.bytes_from_device += res.nbytes
+            self._count_launch(8 * len(rows), res)
+        if res is not None:
             for (key_, lwid, rts), s_, e_, val in zip(meta, qs, qe, res):
                 out = self.result_factory()
                 out.value = float(val) if e_ > s_ else 0.0  # masked
                 out.set_control_fields(key_, lwid, rts)
                 emit(out)
-            # amortized mirror eviction at the fired frontier
-            dead = int(np.searchsorted(st.ts_vals,
-                                       st.next_fire * self.slide_len,
-                                       "left"))
+            # amortized mirror eviction at the fired frontier (the
+            # same proof, via the cursor): the mirror never grows past
+            # the live span + this slack
+            dead = self._dead_count(st)
             if dead > 1024:
                 st.ts_vals = st.ts_vals[dead:]
                 st.ts_base += dead
+                st.dead_idx = 0
 
     def svc(self, item, channel_id, emit):
         if isinstance(item, EOSMarker):
@@ -278,9 +344,10 @@ class WinSeqFFATResidentLogic(NodeLogic):
                     d = min(c + step, hi)
                     ids = np.arange(st.count, st.count + (d - c))
                     st.ts_ring[ids % self.capacity] = tss[c:d]
+                    start_id = st.count
                     st.count += d - c
                     self._ingest_chunk(
-                        np.full(d - c, st.row), ids,
+                        st.row, start_id,
                         vals[c:d].astype(np.float32), [key], emit)
             return
         key, _tid, ts = item.get_control_fields()
@@ -292,7 +359,7 @@ class WinSeqFFATResidentLogic(NodeLogic):
         st = self._key_state(key)
         st.ts_ring[st.count % self.capacity] = ts
         st.count += 1
-        self._ingest_chunk([st.row], [st.count - 1], [lifted], [key], emit)
+        self._ingest_chunk(st.row, st.count - 1, [lifted], [key], emit)
 
     def eos_flush(self, emit):
         """Fire partial tail windows whose extent clips at the stream
@@ -320,7 +387,7 @@ class WinSeqFFATResidentLogic(NodeLogic):
         if self.is_tb:
             keys = {k: (st.row, st.count, st.next_fire,
                         st.ts_vals.copy(), st.ts_base, st.max_ts,
-                        st.anchored)
+                        st.anchored, st.dead_idx)
                     for k, st in self.keys.items()}
         else:
             keys = {k: (st.row, st.count, st.next_fire, st.ts_ring.copy())
@@ -346,9 +413,74 @@ class WinSeqFFATResidentLogic(NodeLogic):
             if self.is_tb:
                 st.ts_vals = np.asarray(fields[3]).copy()
                 st.ts_base, st.max_ts, st.anchored = fields[4:7]
+                # pre-cursor snapshots carry no dead_idx: 0 re-derives
+                st.dead_idx = fields[7] if len(fields) > 7 else 0
             else:
                 st.ts_ring = np.asarray(fields[3]).copy()
             self.keys[k] = st
+
+    # -- keyed-state hooks (elastic/rescale.py): the resident forest IS
+    # the per-key window state, so repartitioning pulls each key's LIVE
+    # leaf span off the device and re-scatters it on the owner replica;
+    # per-key blobs are fusion-invariant (same shape whether the engine
+    # runs standalone or inside a fused segment) ----------------------
+    def keyed_state_dict(self):
+        tree = np.asarray(self.forest.tree)
+        n = self.forest.n
+        out: Dict[Any, dict] = {}
+        for k, st in self.keys.items():
+            if self.is_tb:
+                lo = st.ts_base
+            else:
+                # windows from next_fire on read leaves >= the fired
+                # frontier; earlier ring slots are dead by the proof
+                lo = min(st.next_fire * self.slide_len, st.count)
+            live = np.arange(lo, st.count, dtype=np.int64)
+            leaves = (tree[st.row, n + (live % n)].copy() if len(live)
+                      else np.empty(0, np.float32))
+            blob = {"count": st.count, "next_fire": st.next_fire,
+                    "lo": int(lo), "leaves": leaves}
+            if self.is_tb:
+                blob.update(ts_vals=st.ts_vals.copy(),
+                            ts_base=st.ts_base, max_ts=st.max_ts,
+                            anchored=st.anchored, dead_idx=st.dead_idx)
+            else:
+                blob["ts"] = st.ts_ring[live % self.capacity].copy()
+            out[k] = blob
+        return out
+
+    def load_keyed_state(self, kv) -> None:
+        from ...ops.flatfat_jax import BatchedFlatFAT
+        self.keys.clear()
+        need = self.capacity
+        for blob in kv.values():
+            # a source replica's ring may have grown (TB span growth):
+            # size the fresh forest to the widest migrated span
+            need = max(need, len(blob["leaves"]) + self._chunk_headroom)
+        n = 1
+        while n < need:
+            n <<= 1
+        self.capacity = n
+        self.forest = BatchedFlatFAT(self.combine, self.neutral,
+                                     max(2, len(kv)), n)
+        for k, blob in kv.items():
+            st = _ResidentKey(len(self.keys), self.capacity, self.is_tb)
+            st.count, st.next_fire = blob["count"], blob["next_fire"]
+            if self.is_tb:
+                st.ts_vals = np.asarray(blob["ts_vals"]).copy()
+                st.ts_base = blob["ts_base"]
+                st.max_ts = blob["max_ts"]
+                st.anchored = blob["anchored"]
+                st.dead_idx = blob.get("dead_idx", 0)
+            self.keys[k] = st
+            live = np.arange(blob["lo"], st.count, dtype=np.int64)
+            if not self.is_tb and len(live):
+                st.ts_ring[live % self.capacity] = blob["ts"]
+            leaves = np.asarray(blob["leaves"], np.float32)
+            for c in range(0, len(live), 4096):
+                pos = live[c:c + 4096]
+                self.forest.update(np.full(len(pos), st.row), pos,
+                                   leaves[c:c + 4096])
 
 
 class WinSeqFFATResident(Operator):

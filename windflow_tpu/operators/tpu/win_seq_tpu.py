@@ -249,9 +249,17 @@ class _AsyncDispatcher:
 class _TPUKeyState:
     __slots__ = ("sort_keys", "ts", "values", "pending_sort", "pending_ts",
                  "pending_val", "pending_chunks", "next_fire", "opened_max",
-                 "max_id", "renumber_next", "emit_counter", "anchor")
+                 "max_id", "renumber_next", "emit_counter", "anchor",
+                 "pane_synced", "min_new_id")
 
     def __init__(self, emit_counter_start=0):
+        # resident-lane sync state (ops/window_compute.ResidentPaneCarry):
+        # pane indices below ``pane_synced`` are final in the device
+        # forest; ``min_new_id`` tracks the smallest id appended since
+        # the last launch, so a launch ships only panes the new data
+        # could have changed (None = everything dirty / nothing new)
+        self.pane_synced = None
+        self.min_new_id = None
         # consolidated sorted arrays
         self.sort_keys = np.empty(0, np.int64)
         self.ts = np.empty(0, np.int64)
@@ -294,7 +302,8 @@ class WinSeqTPULogic(NodeLogic):
                  max_batch_delay_ms: float = DEFAULT_MAX_BATCH_DELAY_MS,
                  placement: str = "device",
                  adaptive_batch: bool = False,
-                 rtt_floor_ms: Optional[float] = None):
+                 rtt_floor_ms: Optional[float] = None,
+                 resident: Optional[bool] = None):
         if win_len == 0 or slide_len == 0:
             raise ValueError("win_len and slide_len must be > 0")
         if placement not in PLACEMENTS:
@@ -380,6 +389,14 @@ class WinSeqTPULogic(NodeLogic):
         # ids are per-key dense counters (plq_renumbered_id degenerates
         # to the emit counter), applied on the flushed batch
         self._native = None
+        # resident lane (docs/PLANNER.md "Resident state"): per-key
+        # pane partials stay device-resident across launches; a launch
+        # ships only new/changed partials.  True forces it on (and
+        # takes the Python staging path -- the native engine stages its
+        # own pane buffers), False opts out, None lets the planner
+        # promote eligible device-lane engines.
+        self.resident = resident
+        self._resident = None
         self._plq_counters: Dict[Any, int] = {}
         # non-integral record keys (the reference's templated key types)
         # are interned into a reserved negative int64 range for the
@@ -393,7 +410,7 @@ class WinSeqTPULogic(NodeLogic):
                 and role in (Role.SEQ, Role.PLQ)
                 and cfg.n_outer == 1 and cfg.n_inner == 1
                 and cfg.id_outer == 0 and cfg.id_inner == 0
-                and value_of is None):
+                and value_of is None and resident is not True):
             try:
                 from ...runtime.native import (NativeWindowEngine,
                                                native_available)
@@ -407,6 +424,8 @@ class WinSeqTPULogic(NodeLogic):
                         kind=win_kind)
             except Exception:
                 self._native = None
+        if resident is True:
+            self._enable_resident(required=True)
 
     # -- placement plane (graph/planner.py; docs/PLANNER.md) ---------------
     def apply_placement(self, placement: str,
@@ -422,9 +441,23 @@ class WinSeqTPULogic(NodeLogic):
         self.resolved_placement = placement
         if rtt_floor_ms:
             self.rtt_floor_ms = rtt_floor_ms
-        if placement == "host" \
-                and not isinstance(self.engine, HostComputeEngine):
-            self.engine = HostComputeEngine(self.engine.kind)
+        if placement == "host":
+            # the host lane computes against the host staging store
+            # directly: drop any resident device state (recomputable
+            # from the retained series on a later flip back)
+            self._resident = None
+            for st in self.keys.values():
+                st.pane_synced = None
+                st.min_new_id = None
+            if not isinstance(self.engine, HostComputeEngine):
+                self.engine = HostComputeEngine(self.engine.kind)
+                for cached in ("_count_eng", "_mean_eng"):
+                    if hasattr(self, cached):
+                        delattr(self, cached)
+        elif isinstance(self.engine, HostComputeEngine):
+            # online re-planning (graph/replanner.py) can flip a
+            # host-resolved engine back: restore the XLA lane
+            self.engine = WindowComputeEngine(self.engine.kind)
             for cached in ("_count_eng", "_mean_eng"):
                 if hasattr(self, cached):
                     delattr(self, cached)
@@ -437,6 +470,66 @@ class WinSeqTPULogic(NodeLogic):
             from ...ops.host_compute import HostComputeEngine
             return HostComputeEngine(kind)
         return WindowComputeEngine(kind)
+
+    # -- resident lane (ops/window_compute.ResidentPaneCarry;
+    # docs/PLANNER.md "Resident state & online re-planning") ---------------
+    def resident_eligible(self) -> bool:
+        """Shapes the resident pane carry serves: builtin monoid kind,
+        pane length (gcd(win, slide)) long enough to pre-reduce, role
+        SEQ on a device lane, Python staging (the native engine stages
+        its own pane buffers).  Everything else keeps the rebuild
+        path."""
+        kind = getattr(self.engine, "kind", None)
+        if not (isinstance(kind, str)
+                and kind in ("sum", "count", "max", "min")):
+            return False
+        pane = int(np.gcd(self.win_len, self.slide_len))
+        return (pane >= 16 and self.role == Role.SEQ
+                and self._native is None
+                and self.resolved_placement != "host")
+
+    def _enable_resident(self, required: bool = False) -> bool:
+        if self._resident is not None:
+            return True
+        if not self.resident_eligible():
+            if required:
+                raise ValueError(
+                    "resident=True needs an eligible engine: builtin "
+                    "sum/count/max/min kind, pane length (gcd(win, "
+                    "slide)) >= 16, role SEQ and a device lane -- the "
+                    "rebuild lane serves every other shape")
+            return False
+        from ...ops.window_compute import ResidentPaneCarry
+        pane = int(np.gcd(self.win_len, self.slide_len))
+        self._resident = ResidentPaneCarry(self.engine.kind,
+                                           self.win_len // pane)
+        for st in self.keys.values():
+            st.pane_synced = None
+        return True
+
+    def maybe_enable_resident(self) -> bool:
+        """Planner promotion hook (graph/planner.plan_graph): an
+        undecided (resident=None) engine joins the resident lane when
+        eligible; resident=False opts out, True forced it at
+        construction."""
+        if self.resident is False:
+            return False
+        return self._enable_resident()
+
+    def _reset_resident(self) -> None:
+        """Drop resident device state (restore / lane flip): the next
+        launch re-ships live partials from the host retained series."""
+        if self._resident is not None:
+            self._resident.reset()
+        for st in self.keys.values():
+            st.pane_synced = None
+            st.min_new_id = None
+
+    def device_resident_bytes(self) -> int:
+        """Gauge hook: bytes of window state resident in device memory
+        (the ``Device_state_bytes_resident`` stats field)."""
+        return (self._resident.state_bytes
+                if self._resident is not None else 0)
 
     def svc_init(self) -> None:
         if self.stats is not None and self.stats.operator_name:
@@ -762,6 +855,10 @@ class WinSeqTPULogic(NodeLogic):
         kind = self.engine.kind
         use_panes = (isinstance(kind, str) and kind in self._PANE_KINDS
                      and pane >= 16)
+        if use_panes and self._resident is not None:
+            self._launch_resident(descs, per_key, keys_involved, pane,
+                                  kind, emit)
+            return
         starts = np.empty(len(descs), np.int64)
         ends = np.empty(len(descs), np.int64)
         gwids = np.fromiter((d[1] for d in descs), np.int64, len(descs))
@@ -815,6 +912,101 @@ class WinSeqTPULogic(NodeLogic):
             st = self.keys[k]
             self._evict(st, wa.initial_id_of_key(default_hash(k), self.config,
                                                  self.role))
+
+    def _launch_resident(self, descs, per_key, keys_involved, pane,
+                         kind, emit) -> None:
+        """Resident-lane launch (docs/PLANNER.md "Resident state"):
+        ship only NEW/changed pane partials plus window extents and
+        answer the batch as pane-range queries against the
+        device-resident forest -- one fused scatter+query program per
+        launch, so the window carry never re-ships.  A pane is final
+        once below the fired frontier (the acceptance gate drops
+        tuples behind it), so ``pane_synced``/``min_new_id`` bound the
+        dirty range to O(new data) per launch."""
+        carry = self._resident
+        spans = {}
+        for k in keys_involved:
+            idxs = per_key[k]
+            initial_id = wa.initial_id_of_key(default_hash(k),
+                                              self.config, self.role)
+            lo_p = (min(descs[i][2] for i in idxs) - initial_id) // pane
+            hi_p = -(-(max(descs[i][3] for i in idxs) - initial_id)
+                     // pane)
+            spans[k] = (initial_id, lo_p, hi_p)
+            carry.row_of(k)
+            if carry.needs_grow(hi_p - lo_p):
+                # the batch's pane span (or key count) outgrew the
+                # forest: swap in a bigger EMPTY one and mark EVERY
+                # key dirty -- live partials recompute from the
+                # retained host series, which eviction keeps exactly
+                # down to the oldest unfired window.  (Never migrate
+                # by copying: launches still queued on the dispatcher
+                # scatter into the OLD forest object.)
+                carry.grow(hi_p - lo_p + 64)
+                for st2 in self.keys.values():
+                    st2.pane_synced = None
+        starts = np.empty(len(descs), np.int64)
+        ends = np.empty(len(descs), np.int64)
+        q_rows = np.empty(len(descs), np.int64)
+        gwids = np.fromiter((d[1] for d in descs), np.int64, len(descs))
+        run_rows, run_starts, run_lens, bufs = [], [], [], []
+        for k in keys_involved:
+            st = self.keys[k]
+            self._consolidate(st)
+            initial_id, lo_p, n_end = spans[k]
+            row = carry.rows[k]
+            if st.pane_synced is None:
+                dirty_lo = lo_p
+            else:
+                dirty_lo = st.pane_synced
+                if st.min_new_id is not None:
+                    dirty_lo = min(dirty_lo,
+                                   (st.min_new_id - initial_id) // pane)
+                # panes below this batch's oldest window start are
+                # dead (never read again): skip them even if unsynced
+                dirty_lo = max(dirty_lo, lo_p)
+            dirty_lo = min(dirty_lo, n_end)
+            if n_end > dirty_lo:
+                part = self._pane_partials(st, initial_id + dirty_lo
+                                           * pane, n_end - dirty_lo,
+                                           pane, kind)
+                bufs.append(np.asarray(part, np.float32))
+                # one CONSECUTIVE run of panes per key: ship a
+                # (row, start, len) descriptor, never positions
+                run_rows.append(row)
+                run_starts.append(dirty_lo)
+                run_lens.append(n_end - dirty_lo)
+            for i in per_key[k]:
+                starts[i] = (descs[i][2] - initial_id) // pane
+                ends[i] = -(-(descs[i][3] - initial_id) // pane)
+                q_rows[i] = row
+                if descs[i][4] < 0:  # CB: result ts = last in extent
+                    hi = int(np.searchsorted(st.sort_keys, descs[i][3],
+                                             "left"))
+                    lo = int(np.searchsorted(st.sort_keys, descs[i][2],
+                                             "left"))
+                    d = descs[i]
+                    descs[i] = (d[0], d[1], d[2], d[3],
+                                int(st.ts[hi - 1]) if hi > lo else 0,
+                                d[5])
+            st.pane_synced = n_end
+            st.min_new_id = None
+        cols = {
+            "value": (np.concatenate(bufs) if bufs
+                      else np.empty(0, np.float32)),
+            "run_rows": np.asarray(run_rows, np.int32),
+            "run_starts": np.asarray(run_starts, np.int64),
+            "run_lens": np.asarray(run_lens, np.int32),
+            "q_rows": q_rows,
+        }
+        birth = self._batch_birth or _time.perf_counter()
+        self._batch_birth = None
+        self._submit(cols, starts, ends, gwids, descs, birth, emit,
+                     engine=carry.launch_engine())
+        if self.stats is not None:  # single-writer: ingest thread
+            self.stats.device_state_bytes = carry.state_bytes
+        for k in keys_involved:
+            self._evict(self.keys[k], spans[k][0])
 
     def _count_engine(self):
         # count over panes = sum of per-pane counts
@@ -950,6 +1142,10 @@ class WinSeqTPULogic(NodeLogic):
             st.pending_chunks.append(
                 (k_ids.astype(np.int64), tss_s[lo:hi][keep],
                  vals_s[lo:hi][keep].astype(np.float64)))
+            if self._resident is not None:
+                mn = int(k_ids.min())
+                if st.min_new_id is None or mn < st.min_new_id:
+                    st.min_new_id = mn
             self._buffered_since_launch += len(k_ids)
             st.max_id = max(st.max_id, int(k_ids.max()))
             last_w = wa.last_window_of(st.max_id, initial_id, self.win_len,
@@ -1038,6 +1234,9 @@ class WinSeqTPULogic(NodeLogic):
             st.pending_sort.append(id_)
             st.pending_ts.append(ts)
             st.pending_val.append(self.value_of(t))
+            if self._resident is not None and (
+                    st.min_new_id is None or id_ < st.min_new_id):
+                st.min_new_id = id_
         st.max_id = max(st.max_id, id_)
         self._fire_ready(key, st, id_, hashcode, emit)
         if self.descriptors and self._launch_due():
@@ -1183,6 +1382,10 @@ class WinSeqTPULogic(NodeLogic):
             # np.fromiter on the first launch
             self._saw_nonint_key = any(
                 not isinstance(k, (int, np.integer)) for k in self.keys)
+        # resident carry is NOT part of the snapshot (it is derivable
+        # from the retained host series): drop it so the next launch
+        # re-ships live partials -- restores stay lane-portable
+        self._reset_resident()
 
     def svc_end(self):
         # error-path teardown: eos_flush already drained (and cleared)
@@ -1209,7 +1412,7 @@ class WinSeqTPU(Operator):
                  async_dispatch=True,
                  max_batch_delay_ms=DEFAULT_MAX_BATCH_DELAY_MS,
                  placement="device", adaptive_batch=False,
-                 rtt_floor_ms=None):
+                 rtt_floor_ms=None, resident=None):
         super().__init__(name, 1, RoutingMode.FORWARD, Pattern.WIN_SEQ_TPU)
         self.win_type = win_type
         self.kwargs = dict(
@@ -1220,7 +1423,8 @@ class WinSeqTPU(Operator):
             emit_batches=emit_batches, max_buffer_elems=max_buffer_elems,
             inflight_depth=inflight_depth, async_dispatch=async_dispatch,
             max_batch_delay_ms=max_batch_delay_ms, placement=placement,
-            adaptive_batch=adaptive_batch, rtt_floor_ms=rtt_floor_ms)
+            adaptive_batch=adaptive_batch, rtt_floor_ms=rtt_floor_ms,
+            resident=resident)
         self._renumbering = False
 
     def enable_renumbering(self):

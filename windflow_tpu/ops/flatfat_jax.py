@@ -98,19 +98,26 @@ def _batched_programs(combine: Callable, neutral: float, n: int):
     levels = int(np.log2(n))
     assert 1 << levels == n, "FlatFAT capacity must be a power of two"
 
-    # WINDFLOW_DONATE_FOREST=1 donates the resident tree: the forest is
-    # HBM-resident across the stream's lifetime and every update
-    # returns its successor, so donation halves the forest's HBM
-    # footprint.  Opt-in for now: CPU (the test backend) does not
-    # implement donation, and the relayed-TPU transport has not yet
-    # been exercised with donated buffers.
+    # The resident tree is DONATED (donate_argnums): the forest lives
+    # in HBM across the stream's lifetime, every update returns its
+    # successor, and donation lets XLA reuse the buffer in place --
+    # the double-buffered carry of the reference's rebuild=false mode
+    # (win_seqffat_gpu.hpp:150) without a second tree's footprint.
+    # CPU (the test backend) does not implement donation, so the gate
+    # keeps it off there; WINDFLOW_DONATE_FOREST=0 opts a device
+    # backend out (e.g. a transport not yet exercised with donation).
     import os
     donate = ((0,) if jax.default_backend() != "cpu"
-              and os.environ.get("WINDFLOW_DONATE_FOREST") == "1"
+              and os.environ.get("WINDFLOW_DONATE_FOREST", "1") != "0"
               else ())
 
-    @functools.partial(jax.jit, donate_argnums=donate)
-    def update_sparse(tree, keys, positions, values, valid):
+    # the level sweeps are lax.fori_loop, not Python-unrolled: every
+    # iteration carries fixed shapes, and unrolling 2 x levels rounds
+    # of gather/scatter made the fused program's XLA compile scale
+    # with log(capacity) (tens of seconds on the CPU test backend for
+    # a 2^13-leaf forest); the rolled loop compiles in O(1)
+
+    def _update_body(tree, keys, positions, values, valid):
         """Scatter new leaves at (key, pos) then recompute ONLY the
         touched root paths: O(B log n) work independent of K and n.
         Duplicate parents scatter identical recomputed values, so
@@ -122,40 +129,85 @@ def _batched_programs(combine: Callable, neutral: float, n: int):
         idx = jnp.where(valid, positions + n, 0)
         tree = tree.at[safe_k, idx].set(
             jnp.where(valid, values, tree[safe_k, idx]))
-        for _ in range(levels):
+
+        def level(_j, carry):
+            tree, idx = carry
             parent = idx >> 1
             left = tree[safe_k, 2 * parent]
             right = tree[safe_k, 2 * parent + 1]
             tree = tree.at[safe_k, parent].set(
                 jnp.where(valid, combine(left, right),
                           tree[safe_k, parent]))
-            idx = parent
+            return tree, parent
+
+        tree, _ = jax.lax.fori_loop(0, levels, level, (tree, idx))
         return tree
 
-    @jax.jit
-    def query_ranges(tree, keys, starts, ends, valid):
+    update_sparse = functools.partial(jax.jit, donate_argnums=donate)(
+        _update_body)
+
+    def _query_body(tree, keys, starts, ends, valid):
         """Per-window fold over leaf ring positions [start, end) of each
         window's key tree; same bit-walk as the single-tree query."""
         safe_k = jnp.where(valid, keys, 0)
-        lo = starts + n
-        hi = ends + n
-        left = jnp.full(starts.shape, neutral, tree.dtype)
-        right = jnp.full(starts.shape, neutral, tree.dtype)
-        for _ in range(levels + 1):
+        neutral_col = jnp.full(starts.shape, neutral, tree.dtype)
+
+        def step(_j, carry):
+            lo, hi, left, right = carry
             take_l = (lo < hi) & (lo & 1).astype(bool)
-            left = jnp.where(take_l, combine(left, tree[safe_k, lo]), left)
+            left = jnp.where(take_l, combine(left, tree[safe_k, lo]),
+                             left)
             lo = jnp.where(take_l, lo + 1, lo)
             take_r = (lo < hi) & (hi & 1).astype(bool)
             hi_idx = jnp.where(take_r, hi - 1, hi)
             right = jnp.where(take_r,
-                              combine(tree[safe_k, hi_idx], right), right)
-            hi = hi_idx
-            lo = lo >> 1
-            hi = hi >> 1
+                              combine(tree[safe_k, hi_idx], right),
+                              right)
+            return lo >> 1, hi_idx >> 1, left, right
+
+        _lo, _hi, left, right = jax.lax.fori_loop(
+            0, levels + 1, step,
+            (starts + n, ends + n, neutral_col, neutral_col))
         out = combine(left, right)
         return jnp.where(valid, out, neutral)
 
-    return update_sparse, query_ranges
+    query_ranges = jax.jit(_query_body)
+
+    @functools.partial(jax.jit, donate_argnums=donate)
+    def update_and_query(tree, keys, positions, values, valid,
+                         q_keys, q_starts, q_ends, q_valid):
+        """The fused per-launch program of the resident lane: scatter
+        the chunk's new leaves, recompute their root paths, then answer
+        every due window against the POST-update tree -- decode ->
+        fold -> trigger in ONE launch, so a launch ships only new
+        values in and fired results out, never the resident state."""
+        tree = _update_body(tree, keys, positions, values, valid)
+        out = _query_body(tree, q_keys, q_starts, q_ends, q_valid)
+        return tree, out
+
+    @functools.partial(jax.jit, donate_argnums=donate)
+    def update_runs_and_query(tree, run_rows, run_starts, run_lens,
+                              values, q_keys, q_starts, q_ends,
+                              q_valid):
+        """Run-descriptor form of the fused program: new leaves always
+        land at CONSECUTIVE ring positions per key (arrival order /
+        pane order), so a launch ships only the values plus
+        (row, start, len) triples -- positions are expanded ON DEVICE
+        (12 bytes per run instead of 8 per leaf)."""
+        cum = jnp.cumsum(run_lens)
+        v = jnp.arange(values.shape[0], dtype=jnp.int32)
+        r = jnp.minimum(jnp.searchsorted(cum, v, side="right"),
+                        run_lens.shape[0] - 1)
+        base = cum[r] - run_lens[r]
+        pos = (run_starts[r] + (v - base)) % n
+        keys = run_rows[r]
+        valid = v < cum[-1]
+        tree = _update_body(tree, keys, pos, values, valid)
+        out = _query_body(tree, q_keys, q_starts, q_ends, q_valid)
+        return tree, out
+
+    return (update_sparse, query_ranges, update_and_query,
+            update_runs_and_query)
 
 
 class BatchedFlatFAT:
@@ -179,19 +231,30 @@ class BatchedFlatFAT:
         self.n_keys = n_keys
         self.neutral = neutral
         self.combine = combine
-        self._update, self._query = _batched_programs(combine, neutral, n)
+        (self._update, self._query, self._update_query,
+         self._update_runs_query) = _batched_programs(combine, neutral,
+                                                      n)
         import jax.numpy as jnp
         self.tree = jnp.full((n_keys, 2 * n), neutral, dtype)
         # leaves [n, 2n) start as neutral; internal nodes of a
         # neutral-filled tree are neutral (monoid identity), so no
         # build pass is needed
 
+    @property
+    def state_bytes(self) -> int:
+        """Resident footprint of the forest in device memory (the
+        ``Device_state_bytes_resident`` gauge)."""
+        try:
+            return int(self.tree.nbytes)
+        except Exception:
+            return 0
+
     def update(self, keys, ids, values) -> None:
         """Insert values at ring positions ids % n for their keys."""
         import jax.numpy as jnp
         keys = np.asarray(keys)
         b = 1
-        while b < max(1, len(keys)):
+        while b < max(512, len(keys)):  # floored bucket (see above)
             b <<= 1
         k = np.zeros(b, np.int32)
         p = np.zeros(b, np.int32)
@@ -204,11 +267,11 @@ class BatchedFlatFAT:
         self.tree = self._update(self.tree, jnp.asarray(k), jnp.asarray(p),
                                  jnp.asarray(v), jnp.asarray(ok))
 
-    def query(self, keys, starts, ends) -> np.ndarray:
-        """Window results for extents [starts, ends) in id space (end -
-        start <= n); wrapping ranges are combined as (tail, head) to
-        keep time order."""
-        import jax.numpy as jnp
+    def _pack_queries(self, keys, starts, ends):
+        """Pad query extents to a pow2 bucket with ring-wrap handling:
+        a wrapping range [s, e) is answered as two ordered pieces
+        ([s, n) then [0, e mod n)) so non-commutative combines keep
+        oldest -> newest order.  Returns (k2, s2, e2, ok, wraps, B)."""
         keys = np.asarray(keys, np.int64)
         starts = np.asarray(starts, np.int64)
         ends = np.asarray(ends, np.int64)
@@ -219,7 +282,7 @@ class BatchedFlatFAT:
         wraps = (ends > starts) & (e_raw <= s)
         B = len(keys)
         b = 1
-        while b < max(1, 2 * B):
+        while b < max(256, 2 * B):  # floored bucket: few compiles
             b <<= 1
         k2 = np.zeros(b, np.int32)
         s2 = np.zeros(b, np.int32)
@@ -235,13 +298,114 @@ class BatchedFlatFAT:
         s2[B:2 * B] = 0
         e2[B:2 * B] = np.where(wraps, e_raw, 0)
         ok[B:2 * B] = wraps
-        out = np.asarray(self._query(self.tree, jnp.asarray(k2),
-                                     jnp.asarray(s2), jnp.asarray(e2),
-                                     jnp.asarray(ok)))
+        return k2, s2, e2, ok, wraps, B
+
+    def _combine_pieces(self, out: np.ndarray, wraps: np.ndarray,
+                        B: int) -> np.ndarray:
+        import jax.numpy as jnp
         head, tail = out[:B], out[B:2 * B]
+        if not wraps.any():
+            return head
         combined = np.asarray(self.combine(jnp.asarray(head),
                                            jnp.asarray(tail)))
         return np.where(wraps, combined, head)
+
+    def update_query_launch(self, keys, ids, values, q_keys, q_starts,
+                            q_ends):
+        """Fused scatter + root-path recompute + range query in ONE
+        jitted launch against the donated resident tree (the
+        decode -> fold -> trigger program of the resident lane).
+        Returns ``(dev_out, wraps, B)``: the un-blocked device result
+        (2B wrap pieces) for async dispatch plus what
+        :meth:`finish_query` needs to resolve it on host."""
+        import jax.numpy as jnp
+        keys = np.asarray(keys)
+        # floor the update bucket: padding is cheap device work, and
+        # collapsing the distinct pad shapes to a handful means
+        # steady-state launches never hit a mid-stream XLA compile
+        b = 1
+        while b < max(512, len(keys)):
+            b <<= 1
+        k = np.zeros(b, np.int32)
+        p = np.zeros(b, np.int32)
+        v = np.full(b, self.neutral, np.float32)
+        ok = np.zeros(b, bool)
+        k[: len(keys)] = keys
+        p[: len(keys)] = np.asarray(ids) % self.n
+        v[: len(keys)] = values
+        ok[: len(keys)] = True
+        k2, s2, e2, qok, wraps, B = self._pack_queries(q_keys, q_starts,
+                                                       q_ends)
+        self.tree, out = self._update_query(
+            self.tree, jnp.asarray(k), jnp.asarray(p), jnp.asarray(v),
+            jnp.asarray(ok), jnp.asarray(k2), jnp.asarray(s2),
+            jnp.asarray(e2), jnp.asarray(qok))
+        return out, wraps, B
+
+    def update_runs_query_launch(self, rows, starts, lens, values,
+                                 q_keys, q_starts, q_ends):
+        """Run-descriptor form of :meth:`update_query_launch`: each
+        (rows[i], starts[i], lens[i]) names a CONSECUTIVE run of new
+        leaves for one key; positions expand on device, so the launch
+        ships values + 12 bytes per run instead of 8 bytes per leaf.
+        ``starts`` may be absolute ids (pre-reduced mod n on host, so
+        int32 device arithmetic can never overflow)."""
+        import jax.numpy as jnp
+        rows = np.asarray(rows, np.int64)
+        lens = np.asarray(lens, np.int64)
+        total = int(lens.sum())
+        R = len(rows)
+        rb = 1
+        while rb < max(8, R):  # floored run bucket
+            rb <<= 1
+        rr = np.zeros(rb, np.int32)
+        rs = np.zeros(rb, np.int32)
+        rl = np.zeros(rb, np.int32)
+        rr[:R] = rows
+        rs[:R] = np.asarray(starts, np.int64) % self.n
+        rl[:R] = lens
+        vb = 1
+        while vb < max(512, total):  # floored value bucket
+            vb <<= 1
+        v = np.full(vb, self.neutral, np.float32)
+        v[:total] = values
+        k2, s2, e2, qok, wraps, B = self._pack_queries(q_keys, q_starts,
+                                                       q_ends)
+        self.tree, out = self._update_runs_query(
+            self.tree, jnp.asarray(rr), jnp.asarray(rs),
+            jnp.asarray(rl), jnp.asarray(v), jnp.asarray(k2),
+            jnp.asarray(s2), jnp.asarray(e2), jnp.asarray(qok))
+        return out, wraps, B
+
+    def update_runs_query(self, rows, starts, lens, values, q_keys,
+                          q_starts, q_ends) -> np.ndarray:
+        """Blocking form of :meth:`update_runs_query_launch`."""
+        dev, wraps, B = self.update_runs_query_launch(
+            rows, starts, lens, values, q_keys, q_starts, q_ends)
+        return self.finish_query(dev, wraps, B)
+
+    def finish_query(self, dev_out, wraps, B) -> np.ndarray:
+        """Materialize one launch's query results on host (ring-wrap
+        pieces combined in time order)."""
+        return self._combine_pieces(np.asarray(dev_out), wraps, B)
+
+    def update_query(self, keys, ids, values, q_keys, q_starts,
+                     q_ends) -> np.ndarray:
+        """Blocking form of :meth:`update_query_launch`."""
+        dev, wraps, B = self.update_query_launch(keys, ids, values,
+                                                 q_keys, q_starts, q_ends)
+        return self.finish_query(dev, wraps, B)
+
+    def query(self, keys, starts, ends) -> np.ndarray:
+        """Window results for extents [starts, ends) in id space (end -
+        start <= n); wrapping ranges are combined as (tail, head) to
+        keep time order."""
+        import jax.numpy as jnp
+        k2, s2, e2, ok, wraps, B = self._pack_queries(keys, starts, ends)
+        out = np.asarray(self._query(self.tree, jnp.asarray(k2),
+                                     jnp.asarray(s2), jnp.asarray(e2),
+                                     jnp.asarray(ok)))
+        return self._combine_pieces(out, wraps, B)
 
 
 class FlatFATJax:

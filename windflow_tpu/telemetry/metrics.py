@@ -111,6 +111,29 @@ def render_openmetrics(apps: dict) -> str:
         for _op, reps, lab in per_op():
             out.append(f"{metric}_total{_labels(**lab)} "
                        f"{sum(int(r.get(field, 0) or 0) for r in reps)}")
+    # device-lane derivations (docs/PLANNER.md "Resident state"): NEW
+    # bytes shipped per launch (state never re-ships on the resident
+    # lane, so the >=10x claim is measurable here) + the resident
+    # state footprint gauge
+    family("windflow_device_bytes_per_launch", "gauge",
+           "bytes shipped per device launch (events in + results out)")
+    for _op, reps, lab in per_op():
+        launches = sum(int(r.get("Device_launches", 0) or 0)
+                       for r in reps)
+        if launches:
+            shipped = sum(int(r.get("Bytes_to_device", 0) or 0)
+                          + int(r.get("Bytes_from_device", 0) or 0)
+                          for r in reps)
+            out.append(f"windflow_device_bytes_per_launch"
+                       f"{_labels(**lab)} {shipped // launches}")
+    family("windflow_device_state_bytes_resident", "gauge",
+           "per-key window state resident in device memory")
+    for _op, reps, lab in per_op():
+        resident = sum(int(r.get("Device_state_bytes_resident", 0) or 0)
+                       for r in reps)
+        if resident:
+            out.append(f"windflow_device_state_bytes_resident"
+                       f"{_labels(**lab)} {resident}")
     family("windflow_queue_depth", "gauge",
            "tuples parked in the operator's inbound channels")
     for _op, reps, lab in per_op():
