@@ -99,7 +99,8 @@ def _per_key(effects):
 
 
 def _acc_graph(n, tmp, effects, fault_plan=None, interval=0.03,
-               pace_every=64, pace_s=0.004, acc_par=2, elastic=None):
+               pace_every=64, pace_s=0.004, acc_par=2, elastic=None,
+               delta=False):
     """source -> keyed map (par 2: multi-producer KEYBY alignment) ->
     keyed accumulator -> transactional sink."""
     def acc(t, a):
@@ -111,7 +112,8 @@ def _acc_graph(n, tmp, effects, fault_plan=None, interval=0.03,
 
     cfg = wf.RuntimeConfig(
         durability=DurabilityConfig(epoch_interval_s=interval,
-                                    path=os.path.join(tmp, "epochs")),
+                                    path=os.path.join(tmp, "epochs"),
+                                    delta=delta),
         fault_plan=fault_plan)
     g = wf.PipeGraph("dur_acc", wf.Mode.DEFAULT, config=cfg)
     accb = wf.AccumulatorBuilder(acc) \

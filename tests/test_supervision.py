@@ -1,0 +1,288 @@
+"""Supervised replica self-healing (durability/supervision.py;
+docs/RESILIENCE.md "Supervised replica restart"): a replica crash in a
+``.with_restartable()`` operator under ``RuntimeConfig.supervision``
+heals in place -- quiesce, rebuild from the last committed epoch,
+resume -- with bounded jittered backoff, escalating to the graph-level
+``NodeFailureError`` only when the budget is exhausted.  Plus the wire
+reconnect backoff satellite (distributed/transport.py) and the
+strict-mode stateless-source contract."""
+import collections
+import json
+import os
+import random
+
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core import BasicRecord, DurabilityConfig
+from windflow_tpu.durability import SupervisionConfig
+from windflow_tpu.graph.pipegraph import NodeFailureError
+
+from test_durability import CkptSource, _acc_oracle, _per_key
+
+
+def _assert_healed_exactly_once(effects, n, graph):
+    """Effect-level exactly-once across an IN-PLACE heal.  Unlike a
+    graph restart (fresh stats), a heal keeps the run's counters: the
+    rewound source re-emits its replay window and the epoch-aware sink
+    discards the already-released prefix, so the graph-wide roll-up
+    becomes the inequality ``Sources_emitted >= Sinks_consumed`` with
+    the surplus being exactly that discarded window.  Per-edge books
+    still balance and the effect stream equals the oracle bitwise."""
+    assert len(effects) == n, (len(effects), n)
+    assert len(set(effects)) == len(effects), "duplicate sink effects"
+    oracle = _acc_oracle(n)
+    got = _per_key(effects)
+    assert set(got) == set(oracle)
+    for k in oracle:
+        assert got[k] == oracle[k], (k, got[k][:4], oracle[k][:4])
+    cons = json.loads(graph.stats.to_json())["Conservation"]
+    assert cons["Violations_total"] == 0, cons["Violations"]
+    assert cons["Edges_balanced"], cons
+    assert cons["Sources_emitted"] >= cons["Sinks_consumed"] \
+        + cons["Dead_letters"] + cons["Shed_tuples"], cons
+
+
+def _sup_graph(n, tmp, effects, acc_fn, sup=None, restartable=True,
+               interval=0.03):
+    """source -> keyed map (par 2) -> keyed accumulator (par 2,
+    optionally restartable) -> transactional sink."""
+    def sink(r):
+        if r is not None:
+            effects.append((r.key, r.id, r.value))
+
+    cfg = wf.RuntimeConfig(
+        durability=DurabilityConfig(epoch_interval_s=interval,
+                                    path=os.path.join(tmp, "epochs")),
+        supervision=sup)
+    g = wf.PipeGraph("sup_acc", wf.Mode.DEFAULT, config=cfg)
+    accb = wf.AccumulatorBuilder(acc_fn) \
+        .with_initial_value(BasicRecord(value=0.0)) \
+        .with_parallelism(2)
+    if restartable:
+        accb = accb.with_restartable()
+    g.add_source(CkptSource(n, pace_every=64, pace_s=0.004)) \
+        .add(wf.MapBuilder(lambda t: None).with_key_by()
+             .with_parallelism(2).build()) \
+        .add(accb.build()) \
+        .add_sink(wf.SinkBuilder(sink).with_exactly_once().build())
+    return g
+
+
+def _poison_once(crashed):
+    """An accumulate fn that raises exactly once, on tuple id 600 of
+    key 1 -- deterministically mid-stream, after epochs committed."""
+    def acc(t, a):
+        if t.id == 600 and t.key == 1 and not crashed:
+            crashed.append(1)
+            raise RuntimeError("injected poison tuple")
+        a.value += t.value
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# the heal path: crash -> in-place rebuild -> exactly-once completion
+# ---------------------------------------------------------------------------
+
+def test_supervised_crash_heals_in_place_exactly_once(tmp_path):
+    N = 4000
+    crashed, effects = [], []
+    g = _sup_graph(N, str(tmp_path), effects, _poison_once(crashed),
+                   sup=SupervisionConfig(max_restarts=3, seed=7))
+    g.run()   # no restart runner: the graph survives its own crash
+    assert crashed, "poison never fired"
+    _assert_healed_exactly_once(effects, N, g)
+    assert g._supervisor is not None and g._supervisor.heals == 1
+    evs = [e for e in g.flight.snapshot()
+           if e["kind"] == "replica_restart"]
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["group"] == "pipe0/accumulator"
+    assert ev["node"].startswith("pipe0/accumulator.")
+    assert ev["attempt"] == 1 and ev["budget"] == 3
+    assert ev["delay_s"] > 0 and ev["epoch"] >= 1
+    assert "injected poison tuple" in ev["error"]
+    # epochs kept committing after the heal (the plane was released)
+    assert g.durability.committed > ev["epoch"]
+    # the stats block carries the heal counter, and /metrics mirrors it
+    stats = json.loads(g.stats.to_json())
+    assert stats["Durability"]["Replica_restarts"] == 1
+    from windflow_tpu.telemetry.metrics import render_openmetrics
+    text = render_openmetrics(
+        {"1": {"report": stats, "active": False}})
+    assert "windflow_replica_restarts{" in text
+    # ... and the doctor explains the heal in prose
+    from windflow_tpu.diagnosis.report import build_report, render_text
+    rep = build_report(stats, flight=g.flight.snapshot())
+    assert rep["Replica_restarts"]
+    assert "supervised replica restart(s) (healed" in rep["Verdict"]
+    txt = render_text(rep)
+    assert "replica restarts (supervised self-healing):" in txt
+    assert "rewound to epoch" in txt
+
+
+def test_unsupervised_crash_fails_fast_unchanged(tmp_path):
+    """Without SupervisionConfig the same crash cancels the graph
+    exactly as before -- no heal, no replica_restart events."""
+    N = 4000
+    crashed, effects = [], []
+    g = _sup_graph(N, str(tmp_path), effects, _poison_once(crashed),
+                   sup=None)
+    with pytest.raises(NodeFailureError):
+        g.run()
+    assert crashed
+    assert g._supervisor is None
+    assert not [e for e in g.flight.snapshot()
+                if e["kind"] == "replica_restart"]
+
+
+def test_crash_outside_restartable_operator_escalates(tmp_path):
+    """Supervision armed, but the crashing operator was NOT marked
+    restartable: the failure takes the normal fail-fast path."""
+    N = 4000
+    crashed, effects = [], []
+    g = _sup_graph(N, str(tmp_path), effects, _poison_once(crashed),
+                   sup=SupervisionConfig(max_restarts=3, seed=7),
+                   restartable=False)
+    with pytest.raises(NodeFailureError):
+        g.run()
+    assert not [e for e in g.flight.snapshot()
+                if e["kind"] == "replica_restart"]
+
+
+def test_restart_budget_exhaustion_escalates(tmp_path):
+    """An always-poisoned tuple burns the whole budget, then escalates
+    to NodeFailureError with the escalation named in the flight ring
+    and the doctor verdict."""
+    N = 4000
+    effects = []
+
+    def acc(t, a):
+        if t.id == 600 and t.key == 1:
+            raise RuntimeError("persistent poison tuple")
+        a.value += t.value
+
+    g = _sup_graph(N, str(tmp_path), effects, acc,
+                   sup=SupervisionConfig(max_restarts=2,
+                                         backoff_base_s=0.01,
+                                         backoff_cap_s=0.05, seed=11))
+    with pytest.raises(NodeFailureError):
+        g.run()
+    evs = [e for e in g.flight.snapshot()
+           if e["kind"] == "replica_restart"]
+    healed = [e for e in evs if e.get("outcome") != "escalated"]
+    assert len(healed) == 2  # the full budget was spent healing
+    assert [e["attempt"] for e in healed] == [1, 2]
+    from windflow_tpu.diagnosis.report import build_report
+    rep = build_report(json.loads(g.stats.to_json()),
+                       flight=g.flight.snapshot())
+    assert "FAILED" in rep["Verdict"]
+
+
+def test_supervision_requires_durability_plane(tmp_path):
+    """Supervision without the durability plane has no committed state
+    slice to rebuild from: start() refuses loudly."""
+    g = wf.PipeGraph("sup_nodur", wf.Mode.DEFAULT, config=wf.RuntimeConfig(
+        supervision=SupervisionConfig()))
+    g.add_source(CkptSource(100)) \
+        .add_sink(wf.SinkBuilder(lambda r: None).build())
+    with pytest.raises(RuntimeError, match="needs the durability"):
+        g.start()
+
+
+def test_with_restartable_validation():
+    """.with_restartable() mirrors the elastic contract: the builder
+    must expose a replayable logic factory."""
+    b = wf.AccumulatorBuilder(lambda t, a: None) \
+        .with_initial_value(BasicRecord(value=0.0)) \
+        .with_restartable()
+    op = b.build()
+    assert getattr(op, "restartable", False)
+
+
+# ---------------------------------------------------------------------------
+# backoff envelopes: supervision and the wire reconnect satellite
+# ---------------------------------------------------------------------------
+
+def test_wire_backoff_delay_envelope_and_determinism():
+    from windflow_tpu.distributed.transport import (_BACKOFF_BASE_S,
+                                                    _BACKOFF_CAP_S,
+                                                    _BACKOFF_JITTER,
+                                                    backoff_delay)
+    rng = random.Random(42)
+    prev_base = 0.0
+    for attempt in range(12):
+        base = min(_BACKOFF_CAP_S, _BACKOFF_BASE_S * (2 ** attempt))
+        d = backoff_delay(attempt, rng)
+        assert base <= d <= base * (1.0 + _BACKOFF_JITTER) + 1e-12
+        assert base >= prev_base  # monotone growth to the cap
+        prev_base = base
+    assert prev_base == _BACKOFF_CAP_S
+    # per-edge seeding: the same edge name reproduces its sequence
+    import zlib
+    mk = lambda: random.Random(zlib.crc32(b"wire:pipe0/acc.1"))
+    seq1 = [backoff_delay(a, mk()) for a in range(4)]
+    seq2 = [backoff_delay(a, mk()) for a in range(4)]
+    assert seq1 == seq2
+
+
+def test_wire_reconnect_backoff_rides_flight_ring(monkeypatch):
+    """A sender whose socket keeps failing records one
+    wire_reconnect_backoff flight event per retry, then raises
+    WireError when the reconnect budget is exhausted."""
+    from windflow_tpu.distributed.transport import (RemoteEdgeSender,
+                                                    WireError)
+    from windflow_tpu.telemetry import FlightRecorder
+
+    class _Spec:
+        wire_reconnects = 2
+        wire_credits = 64
+        connect_timeout_s = 0.1
+
+    class _Graph:
+        flight = FlightRecorder(32)
+        stats = None
+
+    sender = RemoteEdgeSender("pipe0/acc.0", "127.0.0.1", 1, _Graph(),
+                              pids=[0], spec=_Spec())
+
+    def boom(self=None):
+        raise OSError("connection refused (test)")
+
+    monkeypatch.setattr(sender, "_ensure_open", boom)
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    with pytest.raises(WireError, match="failed after"):
+        sender._send_frame(b"frame")
+    evs = [e for e in _Graph.flight.snapshot()
+           if e["kind"] == "wire_reconnect_backoff"]
+    assert [e["attempt"] for e in evs] == [1, 2]
+    assert all(e["edge"] == "wire:pipe0/acc.0" for e in evs)
+    assert all(e["delay_s"] > 0 for e in evs)
+    assert evs[0]["delay_s"] <= evs[1]["delay_s"] * 3  # jittered, bounded
+    assert sender.reconnects == 2
+
+
+# ---------------------------------------------------------------------------
+# strict exactly-once satellite: stateless source is fatal
+# ---------------------------------------------------------------------------
+
+def test_strict_mode_rejects_stateless_source(tmp_path):
+    def src(shipper, ctx):
+        return False
+
+    cfg = wf.RuntimeConfig(durability=DurabilityConfig(
+        epoch_interval_s=0.05, path=os.path.join(str(tmp_path), "ep"),
+        strict=True))
+    g = wf.PipeGraph("strict_src", wf.Mode.DEFAULT, config=cfg)
+    g.add_source(wf.SourceBuilder(src).build()) \
+        .add_sink(wf.SinkBuilder(lambda r: None).build())
+    with pytest.raises(RuntimeError, match="strict"):
+        g.start()
+    # without strict the same graph only warns (and runs)
+    cfg2 = wf.RuntimeConfig(durability=DurabilityConfig(
+        epoch_interval_s=0.05, path=os.path.join(str(tmp_path), "ep2")))
+    g2 = wf.PipeGraph("lax_src", wf.Mode.DEFAULT, config=cfg2)
+    g2.add_source(wf.SourceBuilder(src).build()) \
+        .add_sink(wf.SinkBuilder(lambda r: None).build())
+    with pytest.warns(RuntimeWarning, match="replay it from the start"):
+        g2.run()

@@ -122,6 +122,16 @@ def measure() -> tuple:
         bench.run_checkpoint_overhead(N_SMALL)
     out["11_epochs_feed"] = round(r11_on, 1)
     out["11_no_epochs_feed"] = round(r11_off, 1)
+    # delta-snapshot smoke (docs/RESILIENCE.md "Delta snapshots"): the
+    # helper itself asserts the >=10x per-epoch commit-byte ratio at
+    # 1% keyed churn, identical sink effects and a bitwise-equal
+    # restored keyed state between the delta and full lanes; the feed
+    # is paced, so the gated rate catches a wedged encoder/blob path,
+    # not box noise
+    r16 = bench.run_delta_snapshot_overhead()
+    assert r16["commit_bytes"]["ratio"] >= 10, \
+        f"delta commit ratio {r16['commit_bytes']['ratio']} < 10x"
+    out["16_delta_snapshot"] = r16["rate"]
     for q in ("q5", "q7"):
         # per-query warmup: each query's engine ('count'/'max') XLA-
         # compiles on first launch; without this the compile lands in

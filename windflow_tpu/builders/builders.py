@@ -50,6 +50,8 @@ def _alias_camel(cls):
                 # with_elasticity(2, 8) means "start at the minimum")
                 op.elasticity = spec
                 op.parallelism = max(op.parallelism, spec.min_replicas)
+            if getattr(self, "restartable", False):
+                op.restartable = True
             return op
 
         build_wrapper._wf_wrapped = True
@@ -80,6 +82,7 @@ class _BuilderBase:
         self.error_policy = "fail"
         self.elasticity = None
         self.worker_pin = None
+        self.restartable = False
 
     def with_name(self, name: str):
         self.name = name
@@ -137,6 +140,20 @@ class _BuilderBase:
                 "with_elasticity: target_util must be in (0, 1]")
         self.elasticity = ElasticSpec(min_replicas, max_replicas,
                                       target_util)
+        return self
+
+    def with_restartable(self):
+        """Mark this operator's replicas individually restartable under
+        supervision (docs/RESILIENCE.md "Supervised replica restart"):
+        with ``RuntimeConfig.supervision`` set (which requires the
+        durability plane), a crash in one of its replicas is healed in
+        place -- the supervisor quiesces, rebuilds the replica from
+        the last committed epoch's state slice and resumes -- instead
+        of failing the whole graph.  Needs a fresh-replica factory
+        (the same contract as elasticity: single-stage Filter / Map /
+        FlatMap / Accumulator operators); without supervision
+        configured the mark is inert."""
+        self.restartable = True
         return self
 
     def build_ptr(self):

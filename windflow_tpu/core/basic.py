@@ -186,6 +186,46 @@ class DurabilityConfig:
     path: str = "epochs"
     retained: int = 3
     stall_factor: float = 5.0
+    # incremental (delta) snapshots: keyed replica state is serialized
+    # as content-addressed blobs beside the manifest and manifests
+    # reference unchanged blobs from prior epochs instead of
+    # re-pickling them -- commit cost becomes O(changed keys).  Each
+    # replica's manifest entry is a blob CHAIN (base + per-epoch
+    # deltas); after ``delta_chain_max`` links the encoder compacts the
+    # chain back to a fresh base.  Unreferenced blobs are GCed with the
+    # manifests that referenced them (honoring ``retained``).  Off by
+    # default: full re-pickle per epoch, the schema-1 manifest shape.
+    delta: bool = False
+    delta_chain_max: int = 8
+    # strict exactly-once: a source without a state_dict (offset not
+    # checkpointable) is a hard RuntimeError at attach instead of a
+    # RuntimeWarning, so exactly-once cannot silently degrade to
+    # replay-from-start (docs/RESILIENCE.md)
+    strict: bool = False
+
+
+@dataclass(frozen=True)
+class SupervisionConfig:
+    """Replica self-healing policy (durability/supervision.py;
+    docs/RESILIENCE.md "Supervised replica restart").
+
+    ``RuntimeConfig.supervision = SupervisionConfig(...)`` arms the
+    replica supervisor for operators marked ``.with_restartable()``: a
+    replica crash there no longer cancels the graph -- the supervisor
+    quiesces through the rescale machinery, rebuilds the replica from
+    the last committed epoch's state slice and resumes, with bounded
+    jittered exponential backoff between attempts.  Only when
+    ``max_restarts`` attempts are exhausted does the failure escalate
+    to the graph-level ``NodeFailureError`` path.  Requires the
+    durability plane (``RuntimeConfig.durability``): without committed
+    epochs there is no consistent state slice to rebuild from."""
+
+    max_restarts: int = 3
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
+    jitter: float = 0.5
+    # deterministic backoff jitter for tests; None seeds from the OS
+    seed: Optional[int] = None
 
 
 @dataclass
@@ -331,6 +371,13 @@ class RuntimeConfig:
     # idempotent sink contract (SinkBuilder.with_exactly_once).  None
     # (the default) keeps the pre-durability hot path untouched.
     durability: Any = None
+    # SupervisionConfig arming supervised replica self-healing for
+    # operators marked .with_restartable(): replica crashes there are
+    # healed in place from the last committed epoch instead of failing
+    # the graph (durability/supervision.py; docs/RESILIENCE.md).
+    # Requires ``durability``.  None (the default) keeps today's
+    # fail-fast path for every replica.
+    supervision: Any = None
     # -- SLO plane (slo/; docs/OBSERVABILITY.md "SLO plane") ------------
     # slo.SloConfig declaring this graph's objectives (e2e p99 budget,
     # throughput floor, frontier-lag ceiling).  Evaluated continuously

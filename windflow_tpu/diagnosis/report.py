@@ -109,6 +109,27 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
         "trigger": e.get("trigger"),
         "evidence": e.get("evidence"),
     } for e in flight if e.get("kind") == "replacement"]
+    # supervised replica self-healing (durability/supervision.py): the
+    # doctor names every heal attempt -- node, backoff, rewind epoch --
+    # and whether the supervisor eventually escalated
+    heals = [{
+        "t": e.get("t"),
+        "node": e.get("node"),
+        "attempt": e.get("attempt"),
+        "delay_s": e.get("delay_s"),
+        "epoch": e.get("epoch"),
+        "outcome": e.get("outcome"),
+        "error": e.get("error"),
+    } for e in flight if e.get("kind") == "replica_restart"]
+    # tolerant-reader fallbacks (durability/store.py): a torn manifest
+    # or a missing delta blob made the restart walk back to an older
+    # fully-loadable epoch instead of crashing
+    fallbacks = [{
+        "t": e.get("t"),
+        "epoch": e.get("epoch"),
+        "reason": e.get("reason"),
+    } for e in flight if e.get("kind") == "epoch_abort"
+        and e.get("reason") in ("manifest_corrupt", "blob_missing")]
     dur = stats.get("Durability")
     durability = None
     if dur:
@@ -120,6 +141,9 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
             "Aborts": int(dur.get("Aborts", 0) or 0),
             "Stalled": bool(dur.get("Stalled")),
             "Restored_from": dur.get("Restored_from"),
+            "Delta": bool(dur.get("Delta")),
+            "Last_commit_bytes": int(dur.get("Last_commit_bytes", 0)
+                                     or 0),
         }
     report = {
         "Graph": stats.get("PipeGraph_name", "?"),
@@ -137,6 +161,8 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
         "Failures": failures,
         "Arbitrations": arbitrations[-FLIGHT_TAIL:],
         "Replacements": replacements[-FLIGHT_TAIL:],
+        "Replica_restarts": heals[-FLIGHT_TAIL:],
+        "Recovery_fallbacks": fallbacks[-FLIGHT_TAIL:],
         "Flight_tail": list(flight)[-FLIGHT_TAIL:],
     }
     report["Verdict"] = _verdict(report)
@@ -168,6 +194,21 @@ def _verdict(report: dict) -> str:
         parts.append(f"epochs STALLED (committed "
                      f"{dur['Committed_epoch']}, oldest uncommitted "
                      f"{dur['Epoch_lag_s']:.1f}s old)")
+    heals = report.get("Replica_restarts") or []
+    if heals:
+        if any(h.get("outcome") == "escalated" for h in heals):
+            parts.append(f"replica self-heal ESCALATED at "
+                         f"{heals[-1].get('node')} "
+                         f"(attempt {heals[-1].get('attempt')})")
+        else:
+            last = heals[-1]
+            parts.append(f"{len(heals)} supervised replica restart(s) "
+                         f"(healed, last {last.get('node')} rewound to "
+                         f"epoch {last.get('epoch')})")
+    fb = report.get("Recovery_fallbacks") or []
+    if fb:
+        parts.append(f"recovery fell back past {len(fb)} unreadable "
+                     f"snapshot(s) ({fb[-1].get('reason')})")
     bn = report["Bottleneck"] or {}
     if bn.get("Operator"):
         if bn.get("Verdict") == "input_bound":
@@ -306,7 +347,10 @@ def render_text(report: dict) -> str:
                    f"lag={dur['Epoch_lag_s']:.1f}s "
                    f"stalled={dur['Stalled']}"
                    + (f" restored_from={restored}"
-                      if restored is not None else ""))
+                      if restored is not None else "")
+                   + (f" delta_commit_bytes="
+                      f"{dur.get('Last_commit_bytes')}"
+                      if dur.get("Delta") else ""))
     arbs = report.get("Arbitrations") or []
     if arbs:
         out.append("")
@@ -333,6 +377,28 @@ def render_text(report: dict) -> str:
                          f"{ev.get('device_rate_tps')} t/s vs host "
                          f"{ev.get('host_rate_tps')} t/s")
             out.append(line)
+    heals = report.get("Replica_restarts") or []
+    if heals:
+        out.append("")
+        out.append("replica restarts (supervised self-healing):")
+        for h in heals:
+            if h.get("outcome") == "escalated":
+                out.append(f"  [{h.get('t')}] {h.get('node')}: heal "
+                           f"ESCALATED on attempt {h.get('attempt')}: "
+                           f"{h.get('error')}")
+            else:
+                out.append(f"  [{h.get('t')}] {h.get('node')}: attempt "
+                           f"{h.get('attempt')} after "
+                           f"{h.get('delay_s')}s backoff, rewound to "
+                           f"epoch {h.get('epoch')} ({h.get('error')})")
+    fb = report.get("Recovery_fallbacks") or []
+    if fb:
+        out.append("")
+        out.append("recovery fallbacks (torn/missing snapshot data):")
+        for e in fb:
+            out.append(f"  [{e.get('t')}] epoch {e.get('epoch')} "
+                       f"unreadable ({e.get('reason')}) -- fell back "
+                       f"to an older fully-loadable cut")
     hot = report.get("Hot_keys") or []
     if hot:
         out.append("hot keys: " + ", ".join(

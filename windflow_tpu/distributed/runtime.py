@@ -153,35 +153,86 @@ def _worker_durability(cfg, worker_id: int):
     return cfg.durability
 
 
-def _restore_worker(graph, store, epoch: int, plan, worker_id: int) -> int:
+def _restore_worker(graph, store, epoch: int, plan, worker_id: int,
+                    overrides: Optional[dict] = None) -> int:
     """Load this worker's slice of epoch ``epoch`` into an unstarted
     graph.  The manifest was written by the same partition, so its
     stateful-name set must equal the owned stateful set -- a silent
-    partial restore would desync the workers."""
+    partial restore would desync the workers.  ``overrides``
+    (operator name -> new parallelism, from
+    ``run_distributed(parallelism_overrides=...)``) lifts named replica
+    groups out of that contract and repartitions their keyed state
+    through the elastic ``hash % n`` owner function, PROVIDED the
+    whole group lives on this worker -- a group split across workers
+    cannot be repartitioned from one worker's manifest alone."""
     import pickle
-    from ..utils.checkpoint import _is_stateful
+    from ..utils.checkpoint import (_is_stateful, _override_for,
+                                    _replica_group, _repartition_group)
+    from ..durability.delta import load_into
     payload = store.load(epoch)
     states = payload.get("states") or {}
     owned_stateful = set()
     loaded = 0
+    owned_nodes = {}
     for n in graph._all_nodes():
         if plan.get(n.name) != worker_id:
             continue
         if not _is_stateful(n.logic):
             continue
         owned_stateful.add(n.name)
-        blob = states.get(n.name)
-        if blob is not None:
-            n.logic.load_state(pickle.loads(blob))
-            loaded += 1
+        owned_nodes[n.name] = n
     missing = owned_stateful - set(states)
     foreign = set(states) - owned_stateful
+    handled = set()
+    if (missing or foreign) and overrides:
+        groups = set()
+        for name in list(missing) + list(foreign):
+            prefix, _idx = _replica_group(name)
+            if prefix is not None and _override_for(prefix, overrides):
+                groups.add(prefix)
+        for prefix in sorted(groups):
+            off_worker = [n.name for n in graph._all_nodes()
+                          if _replica_group(n.name)[0] == prefix
+                          and plan.get(n.name) != worker_id]
+            if off_worker:
+                raise RuntimeError(
+                    f"parallelism override for {prefix!r} needs the "
+                    f"whole replica group on worker {worker_id}, but "
+                    f"{sorted(off_worker)} are placed elsewhere -- pin "
+                    "the operator to one worker to restore it into a "
+                    "different parallelism (docs/DISTRIBUTED.md)")
+            manifest_names = sorted(
+                n for n in states if _replica_group(n)[0] == prefix)
+            group_logics = sorted(
+                ((_replica_group(nm)[1], nd.logic)
+                 for nm, nd in owned_nodes.items()
+                 if _replica_group(nm)[0] == prefix),
+                key=lambda t: t[0])
+            if not manifest_names or not group_logics:
+                continue
+            _repartition_group(
+                prefix, f"epoch manifest (epoch {epoch})", states,
+                pickle.loads, manifest_names, group_logics)
+            loaded += len(group_logics)
+            handled.update(manifest_names)
+            handled.update(nm for nm in owned_nodes
+                           if _replica_group(nm)[0] == prefix)
+            missing -= {nm for nm in missing
+                        if _replica_group(nm)[0] == prefix}
+            foreign -= set(manifest_names)
     if missing or foreign:
         raise RuntimeError(
             f"epoch manifest (epoch {epoch}) does not match worker "
             f"{worker_id}'s partition: missing states {sorted(missing)}, "
             f"foreign states {sorted(foreign)} -- was the graph or the "
             "partition changed between restarts? (docs/DISTRIBUTED.md)")
+    for name, n in owned_nodes.items():
+        if name in handled:
+            continue
+        blob = states.get(name)
+        if blob is not None:
+            load_into(n.logic, pickle.loads(blob))
+            loaded += 1
     return loaded
 
 
@@ -214,7 +265,8 @@ def worker_main(spec_doc: dict) -> int:
         from ..durability.store import EpochStore
         plan = plan_partition(g)
         store = EpochStore(dcfg.path, dcfg.retained)
-        n = _restore_worker(g, store, int(restore), plan, wid)
+        n = _restore_worker(g, store, int(restore), plan, wid,
+                            overrides=spec_doc.get("overrides") or None)
         g._epoch_restored = int(restore)
         g.flight.record("epoch_restore", epoch=int(restore), replicas=n,
                         worker=wid, attempt=spec_doc.get("attempt", 0))
@@ -266,7 +318,8 @@ def run_distributed(build: Callable, n_workers: int = 2, *,
                     max_restarts: int = 0,
                     timeout_s: float = 300.0,
                     wire: Optional[dict] = None,
-                    observe: bool = True) -> dict:
+                    observe: bool = True,
+                    parallelism_overrides: Optional[dict] = None) -> dict:
     """Run ``build`` as one PipeGraph across ``n_workers`` processes.
 
     Returns a report dict: per-worker stats paths, the merged one-graph
@@ -318,6 +371,7 @@ def run_distributed(build: Callable, n_workers: int = 2, *,
                     workdir, f"stats_w{w}.json"),
                 "restore_epoch": restore,
                 "attempt": attempts,
+                "overrides": parallelism_overrides,
                 "wire": wire or {},
                 "observe": ([observer.host, observer.port]
                             if observer is not None else None),

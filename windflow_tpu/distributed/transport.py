@@ -35,10 +35,12 @@ exact edge and tuple count.
 """
 from __future__ import annotations
 
+import random
 import socket
 import struct
 import threading
 import time as _time
+import zlib
 from collections import deque
 from typing import Dict, Optional
 
@@ -50,6 +52,20 @@ from . import wire
 # socket pacing: short timeouts keep every blocking call cancellable
 _POLL_S = 0.1
 _SEND_TIMEOUT_S = 5.0
+
+# reconnect backoff envelope (RemoteEdgeSender._send_frame): base
+# doubles per attempt up to the cap, then a multiplicative jitter of up
+# to +50% spreads simultaneous retries apart
+_BACKOFF_BASE_S = 0.05
+_BACKOFF_CAP_S = 0.8
+_BACKOFF_JITTER = 0.5
+
+
+def backoff_delay(attempt: int, rng: random.Random) -> float:
+    """Delay in seconds before reconnect ``attempt`` (0-based):
+    capped exponential with multiplicative jitter."""
+    d = min(_BACKOFF_CAP_S, _BACKOFF_BASE_S * (2 ** max(0, attempt)))
+    return d * (1.0 + _BACKOFF_JITTER * rng.random())
 
 
 class WireError(ConnectionError):
@@ -146,6 +162,12 @@ class RemoteEdgeSender:
         self.frames_dropped = 0
         self.reconnects = 0
         self.capacity = None
+        # reconnect backoff (jittered exponential, _send_frame): seeded
+        # per edge so a cluster of senders losing one consumer does not
+        # retry in lockstep, yet each run's delay sequence is
+        # reproducible from the edge name
+        self._backoff_rng = random.Random(
+            zlib.crc32(self.edge_name.encode("utf-8")))
 
     # -- channel duck type ---------------------------------------------
     @property
@@ -319,6 +341,7 @@ class RemoteEdgeSender:
 
     def _send_frame(self, frame: bytes) -> None:
         attempts = int(getattr(self.spec, "wire_reconnects", 2))
+        attempt = 0
         while True:
             try:
                 self._ensure_open()
@@ -335,11 +358,22 @@ class RemoteEdgeSender:
                         f"{self.frames_sent} frames: {e}") from e
                 attempts -= 1
                 self.reconnects += 1
+                # jittered exponential backoff before the reconnect: a
+                # consumer worker restarting must not be hammered at a
+                # fixed 50 ms cadence by every surviving sender at once
+                # (the jitter de-synchronizes them; the per-edge seeded
+                # RNG keeps each run's delay sequence reproducible).
                 # _ensure_open resumes + retransmits; the loop then
                 # re-sends THIS frame (it is the newest unacked one,
                 # so the resume already retransmitted it -- dedup by
                 # sequence makes the extra copy harmless)
-                _time.sleep(0.05)
+                delay = backoff_delay(attempt, self._backoff_rng)
+                attempt += 1
+                self.graph.flight.record(
+                    "wire_reconnect_backoff", edge=self.edge_name,
+                    attempt=attempt, delay_s=round(delay, 4),
+                    error=repr(e))
+                _time.sleep(delay)
 
     def _ensure_open(self) -> None:
         if self._sock is not None:

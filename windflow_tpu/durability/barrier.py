@@ -43,14 +43,27 @@ def iter_named_logics(node):
         yield node.name, node.logic
 
 
-def capture_states(node) -> Dict[str, bytes]:
-    """Pickled per-replica state at the barrier point, keyed by
-    pre-fusion node name.  Serialized IMMEDIATELY on the replica's own
-    thread: several ``state_dict`` implementations alias live stores
+def capture_states(node) -> Dict[str, object]:
+    """Per-replica state at the barrier point, keyed by pre-fusion
+    node name.  Serialized IMMEDIATELY on the replica's own thread:
+    several ``state_dict`` implementations alias live stores
     (AccumulatorLogic), and the stream keeps mutating them the moment
-    the cut completes."""
-    out: Dict[str, bytes] = {}
+    the cut completes.
+
+    Values are pickled ``state_dict`` bytes -- except under
+    ``DurabilityConfig(delta=True)`` for logics with the full keyed
+    contract, which capture as :class:`~windflow_tpu.durability.delta.
+    KeyedCapture` (per-key pickled values) so the coordinator's delta
+    encoder can diff them against the previous epoch's chain."""
+    coord = getattr(node, "epoch_coord", None)
+    delta_on = coord is not None and getattr(coord, "delta", False)
+    out: Dict[str, object] = {}
     for name, logic in iter_named_logics(node):
+        if delta_on:
+            from .delta import KeyedCapture, keyed_capable
+            if keyed_capable(logic):
+                out[name] = KeyedCapture.capture(logic)
+                continue
         getter = getattr(logic, "state_dict", None)
         st = getter() if getter is not None else None
         if st is not None:
@@ -174,6 +187,18 @@ class EpochAligner:
         tracker must not call the node caught up then."""
         return (self.waiting is not None or bool(self.held)
                 or bool(self._replay))
+
+    def reset(self) -> None:
+        """Abandon any open alignment and drop parked items (the
+        replica supervisor's epoch abort: a crashed peer's barrier
+        will never arrive, and held-back post-barrier input is
+        regenerated by the source rewind).  ``finished`` producers and
+        the producer count survive -- they are structural facts, not
+        epoch state."""
+        self.waiting = None
+        self.arrived = set()
+        self.held = []
+        self._replay.clear()
 
     def offer(self, cid, item, process) -> bool:
         """Dispatch one channel item.  Returns True when the aligner

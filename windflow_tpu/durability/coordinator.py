@@ -51,6 +51,13 @@ class EpochCoordinator(threading.Thread):
         self.interval_s = max(0.005, float(dcfg.epoch_interval_s))
         self.stall_s = max(self.interval_s * float(dcfg.stall_factor), 0.5)
         self.store = EpochStore(dcfg.path, dcfg.retained)
+        # incremental snapshots (durability/delta.py): keyed replicas
+        # capture per-key and this thread's encoders turn each capture
+        # into content-addressed blob chains, O(changed keys) per commit
+        self.delta = bool(getattr(dcfg, "delta", False))
+        self._chain_max = int(getattr(dcfg, "delta_chain_max", 8))
+        self._encoders: Dict[str, object] = {}
+        self.delta_bytes = 0      # blob+manifest bytes of last commit
         # monotone announce counter, read lock-free by source injectors.
         # Epoch ids continue ACROSS restarts (run_with_epochs stamps the
         # restored epoch on the graph before start): if numbering reset
@@ -122,14 +129,22 @@ class EpochCoordinator(threading.Thread):
                 # epochs still commit (and measure) fine, but a restart
                 # cannot rewind this source: it would replay from the
                 # beginning against state restored at the epoch --
-                # duplicates.  Loud, not fatal: overhead benches and
+                # duplicates.  DurabilityConfig(strict=True) makes this
+                # fatal (exactly-once must not silently degrade);
+                # otherwise loud, not fatal: overhead benches and
                 # commit-only runs legitimately use stateless sources.
-                warnings.warn(
-                    f"durability: source {n.name!r} has no state_dict "
-                    "(offset not checkpointable) -- restarts will "
-                    "replay it from the start, degrading exactly-once "
-                    "to at-least-once (docs/RESILIENCE.md)",
-                    RuntimeWarning, stacklevel=3)
+                msg = (f"durability: source {n.name!r} has no "
+                       "state_dict (offset not checkpointable) -- "
+                       "restarts will replay it from the start, "
+                       "degrading exactly-once to at-least-once "
+                       "(docs/RESILIENCE.md)")
+                if getattr(self.graph.config.durability, "strict",
+                           False):
+                    raise RuntimeError(
+                        msg + "; DurabilityConfig(strict=True) forbids "
+                        "this -- give the source a checkpointable "
+                        "offset or drop strict")
+                warnings.warn(msg, RuntimeWarning, stacklevel=3)
         dups = sorted({x for x in src_names if src_names.count(x) > 1})
         if dups:
             raise RuntimeError(
@@ -358,10 +373,32 @@ class EpochCoordinator(threading.Thread):
         self._check_stall()
         self.publish()
 
+    def _encode_states(self, states: Dict[str, object]):
+        """Turn a collected state map into its manifest form: inline
+        bytes pass through; ``KeyedCapture`` objects run through the
+        per-replica delta encoders (durability/delta.py) and become
+        ``{"keyed_chain": [...]}`` entries, with the epoch's fresh
+        blobs staged in the returned ``blob_writes``."""
+        from .delta import DeltaEncoder, KeyedCapture
+        blob_writes: Dict[str, bytes] = {}
+        enc: Dict[str, object] = {}
+        for name, v in states.items():
+            if isinstance(v, KeyedCapture):
+                encoder = self._encoders.get(name)
+                if encoder is None:
+                    encoder = self._encoders[name] = DeltaEncoder(
+                        self._chain_max)
+                enc[name] = {"keyed_chain": encoder.encode(
+                    v, blob_writes)}
+            else:
+                enc[name] = v
+        return enc, blob_writes
+
     def _commit(self, epoch: int, states: Dict[str, bytes],
                 offsets: Dict[str, float]) -> None:
         g = self.graph
         t0 = _time.perf_counter()
+        states, blob_writes = self._encode_states(states)
         plan = getattr(g.config, "fault_plan", None)
         if plan is not None and epoch in getattr(plan, "torn_commit_epochs",
                                                  ()):
@@ -381,7 +418,9 @@ class EpochCoordinator(threading.Thread):
             return
         path, nbytes = self.store.commit(
             epoch, states, offsets,
-            meta={"graph": g.name, "committed_at": _time.time()})
+            meta={"graph": g.name, "committed_at": _time.time()},
+            blob_writes=blob_writes)
+        self.delta_bytes = nbytes
         g.flight.record("checkpoint_epoch", epoch=epoch, path=path,
                         replicas=len(states), bytes=nbytes)
         released = 0
@@ -454,6 +493,50 @@ class EpochCoordinator(threading.Thread):
             self._gap = max(0, self._gap - 1)
             self._cond.notify_all()
 
+    # -- supervised replica restart (durability/supervision.py) --------
+    def abort_epochs(self, reason: str, timeout: float = 30.0) -> None:
+        """Drop every in-flight epoch WITHOUT waiting for it to drain
+        -- the supervisor's counterpart to ``hold_epochs``, for when a
+        replica died mid-alignment and its barriers will never arrive
+        (waiting would deadlock).  Only an in-progress manifest write
+        is waited out (it is about to become the committed rewind
+        point).  Announcing stays held until ``release_epochs``;
+        stale barriers/acks for the dropped epochs no-op against the
+        missing pending entries."""
+        deadline = _time.monotonic() + timeout
+        with self._cond:
+            self._gap += 1
+            while self._committing is not None:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break  # commit is durable-or-not; do not deadlock
+                self._cond.wait(min(remaining, 0.05))
+            pending = sorted(self._pending)
+            self._pending.clear()
+            self._cond.notify_all()
+        for e in pending:
+            self.aborts += 1
+            self.graph.flight.record("epoch_abort", epoch=e,
+                                     reason=reason,
+                                     committed=self.committed)
+
+    def resolve_manifest_states(self, m: Optional[dict]
+                                ) -> Dict[str, bytes]:
+        """The ``states`` of a manifest-shaped dict as inline pickled
+        bytes, whatever their stored form: inline bytes pass through,
+        blob chains resolve from the store, raw ``KeyedCapture``
+        objects (final states never committed yet) pack directly."""
+        from .delta import KeyedCapture, pack_keyed
+        out: Dict[str, bytes] = {}
+        for name, v in ((m or {}).get("states", {}) or {}).items():
+            if isinstance(v, KeyedCapture):
+                out[name] = pack_keyed(v.entries)
+            elif isinstance(v, dict) and "keyed_chain" in v:
+                out[name] = self.store.resolve_states({name: v})[name]
+            else:
+                out[name] = v
+        return out
+
     # -- on-demand epoch (PipeGraph.live_checkpoint) -------------------
     def checkpoint_now(self, timeout: float = 60.0
                        ) -> Tuple[int, Dict[str, bytes]]:
@@ -480,8 +563,8 @@ class EpochCoordinator(threading.Thread):
         while True:
             with self._cond:
                 if self.committed >= target:
-                    m = self.last_manifest or {}
-                    return self.committed, dict(m.get("states", {}))
+                    return self.committed, self.resolve_manifest_states(
+                        self.last_manifest)
                 if target not in self._pending \
                         and target != self._committing:
                     # dropped (not mid-commit: drive() pops the pending
@@ -489,7 +572,8 @@ class EpochCoordinator(threading.Thread):
                     # that window for a drop would return empty state):
                     # the stream ended under the barrier -- the final
                     # states are the (complete) snapshot
-                    return self.committed, dict(self._final_states)
+                    return self.committed, self.resolve_manifest_states(
+                        {"states": self._final_states})
                 if _time.monotonic() > deadline:
                     raise RuntimeError(
                         f"durability: forced epoch {target} did not "
@@ -515,7 +599,12 @@ class EpochCoordinator(threading.Thread):
                 "Interval_s": self.interval_s,
                 "Restored_from": self.restored_from,
                 "Path": self.store.dir,
+                "Delta": self.delta,
+                "Last_commit_bytes": self.delta_bytes,
             }
+            sup = getattr(self.graph, "_supervisor", None)
+            if sup is not None:
+                block["Replica_restarts"] = sup.heals
         self.graph.stats.set_durability(block)
 
     def stop(self, clean: bool = True) -> None:
@@ -554,10 +643,12 @@ class EpochCoordinator(threading.Thread):
             epoch = self.epoch_seq
             states = dict(self._final_states)
         try:
+            states, blob_writes = self._encode_states(states)
             path, nbytes = self.store.commit(
                 epoch, states, {},
                 meta={"graph": g.name, "final": True,
-                      "committed_at": _time.time()})
+                      "committed_at": _time.time()},
+                blob_writes=blob_writes)
             g.flight.record("checkpoint_epoch", epoch=epoch, path=path,
                             replicas=len(states), bytes=nbytes,
                             final=True)

@@ -20,26 +20,31 @@ from ..resilience.errors import NodeFailureError
 from .store import EpochStore
 
 
-def restore_epoch(graph, payload: dict) -> int:
-    """Load a committed epoch manifest into an UNSTARTED, structurally
-    identical graph; returns the number of replicas restored.
+def restore_epoch(graph, payload: dict, overrides=None) -> int:
+    """Load a committed epoch manifest into an UNSTARTED graph;
+    returns the number of replicas restored.
 
     Structure checking and state loading are shared with
     ``utils.checkpoint.restore_graph`` (``restore_states``): the
     manifest's stateful-replica names must equal this graph's (names
     are pre-fusion, so any OptLevel restores) -- a silent partial
-    restore would misdistribute keyed state."""
+    restore would misdistribute keyed state.  ``overrides``
+    (operator-name -> new parallelism) lifts named replica groups out
+    of that contract: their keyed state is merged and repartitioned
+    through the elastic ``hash % n`` owner function instead
+    (docs/RESILIENCE.md "Restore into a different parallelism")."""
     from ..utils.checkpoint import restore_states
     return restore_states(
         graph, payload["states"],
         f"epoch manifest (epoch {payload.get('epoch')})",
-        decode=pickle.loads)
+        decode=pickle.loads, overrides=overrides)
 
 
 def run_with_epochs(graph_factory: Callable[[int], Any],
                     max_restarts: int = 3,
                     on_failure: Optional[Callable] = None,
-                    on_restore: Optional[Callable] = None) -> Any:
+                    on_restore: Optional[Callable] = None,
+                    parallelism_overrides: Optional[dict] = None) -> Any:
     """Run ``graph_factory(attempt)`` to completion with epoch-aware
     restarts.  Every graph the factory builds must carry the SAME
     ``RuntimeConfig.durability`` (same manifest path).
@@ -53,7 +58,18 @@ def run_with_epochs(graph_factory: Callable[[int], Any],
     ``truncate_above(epoch)`` an idempotent sink's store.
     ``on_failure(attempt, error, graph)`` observes each failed attempt;
     all failures attach to the finally raised error as
-    ``attempt_history``."""
+    ``attempt_history``.
+
+    ``parallelism_overrides`` ({operator name: new replica count})
+    declares that the factory now builds named operators at a DIFFERENT
+    parallelism than the manifest was written with: their keyed state
+    is repartitioned across the new replica set through the elastic
+    ``hash % n`` contract instead of raising the structure-mismatch
+    error.  Source offsets re-assign by name (sources are
+    parallelism-1 under the durability plane, so their names -- and
+    offsets -- survive any operator rescale unchanged).  The counts
+    are advisory documentation of intent; the authoritative new
+    parallelism is whatever the factory builds."""
     attempt = 0
     history: List[BaseException] = []
     while True:
@@ -67,10 +83,13 @@ def run_with_epochs(graph_factory: Callable[[int], Any],
         store = EpochStore(dcfg.path, dcfg.retained)
         epoch, payload = store.latest(flight=g.flight)
         if epoch is not None:
-            n = restore_epoch(g, payload)
+            n = restore_epoch(g, payload,
+                              overrides=parallelism_overrides)
             g.flight.record("epoch_restore", epoch=epoch, replicas=n,
                             offsets=payload.get("offsets", {}),
-                            attempt=attempt)
+                            attempt=attempt,
+                            repartitioned=sorted(parallelism_overrides)
+                            if parallelism_overrides else [])
             g._epoch_restored = epoch
             if on_restore is not None:
                 on_restore(g, epoch, payload)

@@ -13,6 +13,18 @@ truncated or wrong-schema manifest is skipped (newest-first) with an
 ``epoch_abort`` flight event naming the file, falling back to the
 previous committed epoch instead of crashing the restart in
 ``pickle.load``.
+
+Schema 2 (``DurabilityConfig(delta=True)``; durability/delta.py): a
+keyed replica's ``states`` entry may be ``{"keyed_chain": [BlobRef,
+...]}`` referencing content-addressed blobs under ``<path>/blobs/``
+instead of inline bytes.  Blobs are written (atomically, skip-if-
+exists) BEFORE the manifest that references them, so a committed
+manifest's chain is always durable.  Readers resolve chains back to
+inline bytes; ``latest()`` treats an unresolvable chain as one more
+skippable damage mode with its own ``epoch_abort(blob_missing)``
+event.  Blob GC is mark-and-sweep over the retained manifests after
+each retire pass (and skips entirely when any retained manifest fails
+to parse -- never delete what a manifest might still reference).
 """
 from __future__ import annotations
 
@@ -22,7 +34,10 @@ import re
 from typing import Dict, List, Optional, Tuple
 
 MANIFEST_MAGIC = "windflow-epoch-manifest"
-MANIFEST_SCHEMA = 1
+# max schema this runtime reads; commits write 1 (inline states only)
+# or 2 (some states entries are blob chains) so pre-delta runtimes
+# keep reading full-snapshot manifests
+MANIFEST_SCHEMA = 2
 _NAME_RE = re.compile(r"^epoch-(\d+)\.ckpt$")
 
 
@@ -89,8 +104,10 @@ class EpochStore:
     tolerant newest-first reads."""
 
     def __init__(self, path: str, retained: int = 3):
+        from .delta import BlobStore
         self.dir = path
         self.retained = max(1, int(retained))
+        self.blobs = BlobStore(os.path.join(path, "blobs"))
         os.makedirs(self.dir, exist_ok=True)
 
     def manifest_path(self, epoch: int) -> str:
@@ -111,16 +128,30 @@ class EpochStore:
     # -- commit --------------------------------------------------------
     def commit(self, epoch: int, states: Dict[str, bytes],
                offsets: Dict[str, float],
-               meta: Optional[dict] = None) -> Tuple[str, int]:
-        """Atomically persist epoch ``epoch``; returns (path, bytes)."""
-        payload = {"magic": MANIFEST_MAGIC, "schema": MANIFEST_SCHEMA,
+               meta: Optional[dict] = None,
+               blob_writes: Optional[Dict[str, bytes]] = None
+               ) -> Tuple[str, int]:
+        """Atomically persist epoch ``epoch``; returns (path, bytes
+        written for this epoch: manifest + fresh blobs).  ``blob_writes``
+        (digest -> payload) land BEFORE the manifest so a crash between
+        the two leaves an unreferenced blob, never a dangling chain."""
+        nbytes = 0
+        if blob_writes:
+            for digest, payload_b in blob_writes.items():
+                self.blobs.write(digest, payload_b)
+                nbytes += len(payload_b)
+        chains = any(isinstance(v, dict) and "keyed_chain" in v
+                     for v in states.values())
+        payload = {"magic": MANIFEST_MAGIC,
+                   "schema": 2 if chains else 1,
                    "epoch": int(epoch), "states": dict(states),
                    "offsets": dict(offsets), "meta": dict(meta or {})}
         blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
         path = self.manifest_path(epoch)
         atomic_write_bytes(path, blob)
         self._retire()
-        return path, len(blob)
+        self._gc_blobs()
+        return path, len(blob) + nbytes
 
     def write_torn(self, epoch: int, states: Dict[str, bytes],
                    offsets: Dict[str, float]) -> str:
@@ -145,10 +176,30 @@ class EpochStore:
             except OSError:
                 pass
 
+    def _gc_blobs(self) -> None:
+        """Mark-and-sweep blob GC over the retained manifests.  A
+        retained manifest that fails to parse vetoes the whole sweep:
+        its references are unknown, and deleting a blob it still needs
+        would turn one damaged epoch into an unrestorable store."""
+        from .delta import chain_refs
+        on_disk = self.blobs.digests_on_disk()
+        if not on_disk:
+            return
+        live = set()
+        for e in self._epochs_on_disk():
+            try:
+                m = self._load_raw(e)
+            except RuntimeError:
+                return  # unknown references: never sweep
+            for ref in chain_refs(m.get("states", {})):
+                live.add(ref.digest)
+        for d in on_disk:
+            if d not in live:
+                self.blobs.unlink(d)
+
     # -- tolerant read -------------------------------------------------
-    def load(self, epoch: int) -> dict:
-        """One manifest, validated; raises RuntimeError with the path
-        named on a torn/foreign/newer-schema file."""
+    def _load_raw(self, epoch: int) -> dict:
+        """One manifest, header-validated, chains UNresolved."""
         path = self.manifest_path(epoch)
         try:
             payload = load_pickle(path, "epoch manifest")
@@ -160,17 +211,54 @@ class EpochStore:
                         "epoch manifest")
         return payload
 
+    def resolve_states(self, states: Dict[str, object]) -> Dict[str, bytes]:
+        """Replace every ``{"keyed_chain": [...]}`` entry with inline
+        packed-keyed bytes (delta.KEYED_STATE_MARKER payloads), leaving
+        schema-1 inline bytes untouched.  Raises RuntimeError on a
+        missing/corrupt blob."""
+        from .delta import pack_keyed, resolve_chain
+        out: Dict[str, bytes] = {}
+        for name, v in states.items():
+            if isinstance(v, dict) and "keyed_chain" in v:
+                out[name] = pack_keyed(
+                    resolve_chain(self.blobs, v["keyed_chain"]))
+            else:
+                out[name] = v
+        return out
+
+    def load(self, epoch: int) -> dict:
+        """One manifest, validated and chain-resolved (``states`` holds
+        inline bytes regardless of schema); raises RuntimeError with
+        the path named on a torn/foreign/newer-schema file or an
+        unresolvable blob chain."""
+        payload = self._load_raw(epoch)
+        payload["states"] = self.resolve_states(payload["states"])
+        return payload
+
     def latest(self, flight=None) -> Tuple[Optional[int], Optional[dict]]:
         """Newest loadable manifest, skipping damaged ones newest-first
         (each skip recorded as an ``epoch_abort`` flight event when a
-        recorder is given).  (None, None) when nothing is committed."""
+        recorder is given): a torn manifest is ``manifest_corrupt``, a
+        manifest whose blob chain lost a link is ``blob_missing``.
+        (None, None) when nothing is committed."""
         for e in reversed(self._epochs_on_disk()):
             try:
-                return e, self.load(e)
+                payload = self._load_raw(e)
             except RuntimeError as err:
                 if flight is not None:
                     flight.record("epoch_abort", epoch=e,
                                   reason="manifest_corrupt",
+                                  path=self.manifest_path(e),
+                                  error=str(err))
+                continue
+            try:
+                payload["states"] = self.resolve_states(
+                    payload["states"])
+                return e, payload
+            except RuntimeError as err:
+                if flight is not None:
+                    flight.record("epoch_abort", epoch=e,
+                                  reason="blob_missing",
                                   path=self.manifest_path(e),
                                   error=str(err))
         return None, None

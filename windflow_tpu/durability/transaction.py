@@ -118,6 +118,19 @@ class TransactionalSinkLogic(SinkLogic):
         the remaining sealed + open buffers are the final commit."""
         return self._release_all()
 
+    def epoch_rewind(self, committed: int) -> int:
+        """Supervised replica restart (durability/supervision.py): the
+        stream rewinds to epoch ``committed``, so every uncommitted
+        buffer -- sealed above it or still open -- is about to be
+        REGENERATED by the source replay.  Discard them; releasing
+        later would duplicate.  Returns the discarded count."""
+        with self._lock:
+            drop = [e for e in self._sealed if e > committed]
+            n = sum(len(self._sealed.pop(e)) for e in drop)
+            n += len(self._buf)
+            self._buf = []
+        return n
+
     def eos_flush(self, emit):
         if self._coordinated:
             # a durable graph releases at the COORDINATOR's final
@@ -201,6 +214,15 @@ class IdempotentSinkLogic(NodeLogic):
         """Restored run (coordinator attach): effects before the first
         new barrier belong to the epoch after the restored one."""
         self._epoch = committed + 1
+
+    def epoch_rewind(self, committed: int) -> int:
+        """Supervised replica restart: the source replay is about to
+        re-apply every effect above ``committed`` -- truncate them
+        from the store so the replay lands them exactly once, and
+        re-anchor the tag counter."""
+        n = self.store.truncate_above(committed)
+        self._epoch = committed + 1
+        return n
 
     def eos_flush(self, emit):
         done = getattr(self.store, "eos", None)

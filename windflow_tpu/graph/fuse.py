@@ -63,8 +63,11 @@ def _is_elastic(node: RtNode) -> bool:
     # the ingest credit boundary: the rescale protocol rebuilds replica
     # threads and rewires their channels at runtime, which requires the
     # operator's nodes to stay their own threads with their own
-    # channels
-    return getattr(node, "elastic_group", None) is not None
+    # channels.  Supervised replicas (durability/supervision.py) are
+    # barred for the same reason: the supervisor rebuilds a crashed
+    # replica in place, reusing its channel and outlets.
+    return getattr(node, "elastic_group", None) is not None \
+        or getattr(node, "supervised_group", None) is not None
 
 
 def _partition_splits(graph, a: RtNode, b: RtNode) -> bool:

@@ -198,6 +198,24 @@ class MultiPipe:
         self._op_names.append(stage.name)
         if stage.elastic is not None:
             self._register_elastic(stage, replica_nodes, elastic_outlets)
+        if stage.restartable:
+            self._register_restartable(stage, replica_nodes)
+
+    def _register_restartable(self, stage: StageSpec,
+                              replica_nodes) -> None:
+        """Register a wired restartable stage with the graph's
+        supervised registry (durability/supervision.py): the replica
+        supervisor rebuilds crashed replicas of these groups from the
+        last committed epoch instead of failing the graph."""
+        from ..durability.supervision import SupervisedGroup
+        key = f"{self.name}/{stage.name}"
+        if key in self.graph.supervised:
+            raise RuntimeError(f"restartable operator {key!r} already "
+                               "registered")
+        for node in replica_nodes:
+            node.supervised_group = key
+        self.graph.supervised[key] = SupervisedGroup(
+            key, self, stage.elastic_factory, list(replica_nodes))
 
     def _register_elastic(self, stage: StageSpec, replica_nodes,
                           outlets) -> None:
@@ -264,6 +282,7 @@ class MultiPipe:
             op.enable_renumbering()
         stages = op.stages()
         self._prepare_elastic(op, stages)
+        self._prepare_restartable(op, stages)
         for i, stage in enumerate(stages):
             if stage.error_policy is None:
                 stage.error_policy = getattr(op, "error_policy", "fail")
@@ -299,6 +318,35 @@ class MultiPipe:
                 "protocol does not migrate (docs/ELASTIC.md)")
         stages[0].elastic = spec
         stages[0].elastic_factory = factory
+
+    def _prepare_restartable(self, op: Operator,
+                             stages: List[StageSpec]) -> None:
+        """Validate and mark a .with_restartable() declaration
+        (docs/RESILIENCE.md "Supervised replica restart").  The replica
+        rebuild reuses the elastic-plane recipe, so the structural
+        requirements are the elastic ones: a single collector-less
+        stage whose operator kind exposes a fresh-replica factory, in
+        DEFAULT mode."""
+        if not getattr(op, "restartable", False):
+            return
+        factory = op.elastic_logic_factory()
+        if (factory is None or len(stages) != 1
+                or stages[0].collector is not None
+                or stages[0].groups is not None
+                or stages[0].group_emitters is not None):
+            raise ValueError(
+                f"operator {op.name!r} cannot be restartable: replica "
+                "supervision supports single-stage Filter/Map/FlatMap/"
+                "Accumulator operators with a fresh-replica factory "
+                "(docs/RESILIENCE.md)")
+        if self.graph.mode != Mode.DEFAULT:
+            raise ValueError(
+                "restartable operators require Mode.DEFAULT: ordering/"
+                "K-slack collectors bind per-channel state the replica "
+                "rebuild does not migrate (docs/RESILIENCE.md)")
+        stages[0].restartable = True
+        if stages[0].elastic_factory is None:
+            stages[0].elastic_factory = factory
 
     def _swap_cb_broadcast(self, stage: StageSpec, win_type) -> None:
         """CB windows entering a window-multicast (WF-rooted) stage in

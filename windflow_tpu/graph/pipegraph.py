@@ -81,6 +81,13 @@ class PipeGraph:
         self.elastic = {}
         self._rescale_lock = threading.Lock()
         self._controller = None
+        # supervised replica self-healing (durability/supervision.py;
+        # docs/RESILIENCE.md): registry of restartable operators
+        # (key -> SupervisedGroup, filled at wiring) and the healing
+        # thread, built at start() when RuntimeConfig.supervision is
+        # set on top of the durability plane
+        self.supervised = {}
+        self._supervisor = None
         # audit plane (audit/; docs/OBSERVABILITY.md): the online
         # flow-conservation ledger + frontier tracker + skew census
         # thread, built at start() when RuntimeConfig.audit is on
@@ -418,12 +425,32 @@ class PipeGraph:
             from ..durability import EpochCoordinator
             self.durability = EpochCoordinator(self)
             self.durability.attach()
+        # supervised replica self-healing (durability/supervision.py):
+        # opt-in via RuntimeConfig.supervision, and only on top of the
+        # durability plane -- the heal rewinds the graph to the last
+        # committed epoch, which does not exist without one.  Built
+        # BEFORE the replica threads start: the supervisor's pre-start
+        # state capture is the rewind point until the first commit.
+        if self.config.supervision is not None:
+            if self.durability is None:
+                raise RuntimeError(
+                    "RuntimeConfig.supervision needs the durability "
+                    "plane: a supervised restart rewinds to the last "
+                    "committed epoch (set RuntimeConfig.durability)")
+            if self.supervised:
+                from ..durability.supervision import ReplicaSupervisor
+                self._supervisor = ReplicaSupervisor(self)
+                for grp in self.supervised.values():
+                    for n in grp.replicas:
+                        n.supervisor = self._supervisor
         for n in self._all_nodes():
             n.start()
         if self.auditor is not None:
             self.auditor.start()
         if self.durability is not None:
             self.durability.start()
+        if self._supervisor is not None:
+            self._supervisor.start()
         # watchdog AFTER the replica threads: it treats "no node alive"
         # as graph completion, so starting it first would let it exit
         # before the first node ever ran
@@ -482,6 +509,12 @@ class PipeGraph:
 
     def wait_end(self) -> None:
         errors, stuck = self._join_all()
+        if self._supervisor is not None:
+            # a heal in flight holds the sources paused, so _join_all
+            # cannot return mid-heal; stopping here just retires the
+            # healing thread (and any replica it swapped in joined
+            # through the re-listing join loop above)
+            self._supervisor.stop()
         self._ended = True
         if self.replanner is not None:
             self.replanner.stop()

@@ -31,10 +31,13 @@ from ..telemetry.histogram import LogHistogram
 # 7 = adds the optional Tenant block (serving plane identity: name,
 # state, priority/weight, live credit lease, arbitration count --
 # serving/server.py publishes it per tenant graph).
+# 8 = the Durability block gains Delta / Last_commit_bytes (delta
+# snapshot sizing) and the optional Replica_restarts counter
+# (supervised self-healing, durability/supervision.py).
 # Readers (doctor CLI, dashboard /explain, tests) must tolerate MISSING
 # blocks rather than dispatch on this number: older dumps carry no
 # version field at all, and every block is optional by contract.
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 
 
 @dataclass

@@ -57,6 +57,11 @@ class StageSpec:
     # wired stage with the graph's elastic registry.
     elastic: Optional[object] = None
     elastic_factory: Optional[object] = None
+    # supervised replica restart (durability/supervision.py;
+    # docs/RESILIENCE.md): True + a non-None elastic_factory makes the
+    # stage's replicas individually rebuildable after a crash.  Filled
+    # from the operator's .with_restartable() mark by MultiPipe.add.
+    restartable: bool = False
 
 
 class Operator:
@@ -84,6 +89,9 @@ class Operator:
         # distributed-runtime worker pin (.with_worker(i)); None =
         # placed by the partition planner (docs/DISTRIBUTED.md)
         self.worker = None
+        # .with_restartable(): replicas individually healable under
+        # RuntimeConfig.supervision (durability/supervision.py)
+        self.restartable = False
 
     # -- to be provided by subclasses --------------------------------------
     def stages(self) -> List[StageSpec]:

@@ -705,6 +705,15 @@ class RtNode(threading.Thread):
         self.epochs = None
         self.epoch_barriers_in = 0
         self.epoch_barriers_out = 0
+        # supervised replica self-healing (durability/supervision.py):
+        # the graph ReplicaSupervisor and this replica's group key,
+        # bound at start for .with_restartable() stages under
+        # RuntimeConfig.supervision.  An accepted crash exits WITHOUT
+        # the svc_end/flush_eos teardown -- the rebuilt replica reuses
+        # this node's outlets, so their producer slots must stay open
+        self.supervisor = None
+        self.supervised_group = None
+        self._supervised_handoff = False
         self._accepts_chunks = False  # resolved per thread (durable path)
         self._sync_emit = True
 
@@ -1022,32 +1031,45 @@ class RtNode(threading.Thread):
         except GraphCancelled:
             self.cancelled = True  # clean unwind, not a failure
         except BaseException as e:  # surfaced by PipeGraph.wait_end
-            self.error = e
-            traceback.print_exc()
-            # poison every channel of the graph so blocked peers unwind
-            # instead of deadlocking on this dead replica's channel
-            if self.cancel_token is not None:
-                self.cancel_token.cancel(e, origin=self.name)
-        finally:
-            # svc_end BEFORE closing outlets: teardown hooks (e.g. the
-            # device dispatcher abort) must stop emitting before the EOS
-            # sentinel is enqueued downstream
-            try:
-                self.logic.svc_end()
-            except GraphCancelled:
-                self.cancelled = True
-            except BaseException as e:
-                if self.error is None:
-                    self.error = e
-                    if self.cancel_token is not None:
-                        self.cancel_token.cancel(e, origin=self.name)
+            if self.supervisor is not None and isinstance(e, Exception) \
+                    and self.supervisor.report_failure(self, e):
+                # supervised replica (durability/supervision.py): the
+                # supervisor rebuilds this replica in place from the
+                # last committed epoch -- no error, no graph cancel,
+                # and no teardown (the flag below skips the finally
+                # block: the rebuilt node reuses these outlets, so
+                # svc_end/flush_eos must not close their producer
+                # slots downstream)
+                self._supervised_handoff = True
+            else:
+                self.error = e
                 traceback.print_exc()
-            try:
-                for o in self.outlets:
-                    o.flush_eos()
-            except GraphCancelled:
-                # downstream already poisoned: nobody is listening
-                self.cancelled = True
+                # poison every channel of the graph so blocked peers
+                # unwind instead of deadlocking on this dead replica's
+                # channel
+                if self.cancel_token is not None:
+                    self.cancel_token.cancel(e, origin=self.name)
+        finally:
+            if not self._supervised_handoff:
+                # svc_end BEFORE closing outlets: teardown hooks (e.g.
+                # the device dispatcher abort) must stop emitting before
+                # the EOS sentinel is enqueued downstream
+                try:
+                    self.logic.svc_end()
+                except GraphCancelled:
+                    self.cancelled = True
+                except BaseException as e:
+                    if self.error is None:
+                        self.error = e
+                        if self.cancel_token is not None:
+                            self.cancel_token.cancel(e, origin=self.name)
+                    traceback.print_exc()
+                try:
+                    for o in self.outlets:
+                        o.flush_eos()
+                except GraphCancelled:
+                    # downstream already poisoned: nobody is listening
+                    self.cancelled = True
 
 
 class SourceLoopLogic(NodeLogic):

@@ -373,6 +373,22 @@ def render_openmetrics(apps: dict) -> str:
         if dur:
             out.append(f"windflow_epoch_stalled{_labels(**lab)} "
                        f"{1 if dur.get('Stalled') else 0}")
+    family("windflow_epoch_commit_bytes", "gauge",
+           "manifest + staged blob bytes written by the last epoch "
+           "commit (delta snapshots shrink this under low churn)")
+    for rep, lab in per_graph():
+        dur = rep.get("Durability") or {}
+        if dur:
+            out.append(f"windflow_epoch_commit_bytes{_labels(**lab)} "
+                       f"{int(dur.get('Last_commit_bytes', 0) or 0)}")
+    family("windflow_replica_restarts", "counter",
+           "supervised replica restarts healed in place "
+           "(durability/supervision.py)")
+    for rep, lab in per_graph():
+        dur = rep.get("Durability") or {}
+        if dur:
+            out.append(f"windflow_replica_restarts{_labels(**lab)} "
+                       f"{int(dur.get('Replica_restarts', 0) or 0)}")
     family("windflow_e2e_latency_seconds", "histogram",
            "traced source-to-sink latency")
     for rep, lab in per_graph():

@@ -103,20 +103,106 @@ def read_snapshot(path: str) -> Dict[str, Any]:
     return payload  # legacy header-less state map
 
 
-def restore_states(graph, states: Dict[str, Any], describe: str,
-                   decode=None) -> int:
-    """Load a state map into a structurally identical graph, shared by
-    ``restore_graph`` and the epoch-manifest restore
-    (durability/recovery.py).  Returns the number of replicas restored.
+def _replica_group(name: str):
+    """Split a replica node name into (group_prefix, index): names end
+    with ``.<int>`` per the wiring convention (multipipe._append_stage).
+    Returns (None, None) for un-indexed names (sources, collectors)."""
+    base, dot, idx = name.rpartition(".")
+    if dot and idx.isdigit():
+        return base, int(idx)
+    return None, None
 
-    Raises BEFORE loading anything if the map's stateful-node names
-    differ from this graph's: in either direction the resume would
+
+def _override_for(prefix: str, overrides) -> Optional[str]:
+    """The override key authorizing repartition of replica group
+    ``prefix`` (e.g. ``pipe0/acc``): exact prefix, its last path
+    component (the operator name), or a substring -- the same loose
+    matching PipeGraph.rescale applies to elastic registry keys."""
+    if not overrides:
+        return None
+    tail = prefix.rsplit("/", 1)[-1]
+    for key in overrides:
+        if key == prefix or key == tail or key in prefix:
+            return key
+    return None
+
+
+def _slice_keyed_entries(decoded: Any, scratch) -> Dict[Any, Any]:
+    """One manifest slice -> {key: value}.  Delta manifests resolve to
+    keyed marker payloads (durability/delta.py) that unpack directly;
+    schema-1 slices are opaque ``state_dict`` pickles, so the slice is
+    decoded THROUGH a scratch logic of the destination group
+    (``load_state`` then ``keyed_state_dict``) -- the logic's own
+    serialization round-trip is the only universal way back to per-key
+    form.  The scratch logic's state is clobbered; callers overwrite
+    it with its final partition afterwards."""
+    from ..durability.delta import is_keyed_payload, unpack_keyed
+    if is_keyed_payload(decoded):
+        return unpack_keyed(decoded)
+    scratch.load_state(decoded)
+    return dict(scratch.keyed_state_dict())
+
+
+def _repartition_group(prefix: str, describe: str, states, decode,
+                       manifest_names, group_logics) -> None:
+    """Repartition one replica group's manifest keyed state into a
+    different replica count through the elastic ``hash % n`` contract
+    (elastic/rescale.py owns the partitioner and the duplicate-key
+    invariant)."""
+    from ..durability.delta import keyed_capable
+    from ..elastic.rescale import partition_keyed_state
+    new_n = len(group_logics)
+    for idx, logic in group_logics:
+        if not keyed_capable(logic):
+            raise RuntimeError(
+                f"{describe}: parallelism override for {prefix!r} "
+                f"needs the keyed-state contract, but replica "
+                f"{prefix}.{idx}'s logic ({type(logic).__name__}) "
+                "does not implement keyed_state_dict/load_keyed_state")
+    scratch = group_logics[0][1]
+    merged: Dict[Any, Any] = {}
+    for name in manifest_names:
+        st = states[name]
+        decoded = decode(st) if decode is not None else st
+        for k, v in _slice_keyed_entries(decoded, scratch).items():
+            if k in merged:
+                raise RuntimeError(
+                    f"{describe}: key {k!r} appears in more than one "
+                    f"manifest slice of {prefix!r} -- the snapshot "
+                    "violates the single-owner contract; refusing to "
+                    "merge")
+            merged[k] = v
+    parts = partition_keyed_state(merged, new_n)
+    for i, (idx, logic) in enumerate(
+            sorted(group_logics, key=lambda t: t[0])):
+        logic.load_keyed_state(parts[i])
+
+
+def restore_states(graph, states: Dict[str, Any], describe: str,
+                   decode=None, overrides=None) -> int:
+    """Load a state map into a graph, shared by ``restore_graph`` and
+    the epoch-manifest restore (durability/recovery.py).  Returns the
+    number of replicas restored.
+
+    Without ``overrides`` the graph must be structurally identical:
+    raises BEFORE loading anything if the map's stateful-node names
+    differ from this graph's -- in either direction the resume would
     silently run with misdistributed window state (e.g. an N-replica
     farm snapshot into a coalesced single-engine lowering, or vice
     versa).  Which nodes are stateful is determined by the graph
     structure, not by stream data, so set equality is the structure
-    check.  ``decode`` maps each stored entry to the ``load_state``
-    argument (the manifest path stores pickled blobs)."""
+    check.  ``decode`` maps each stored entry to the load argument
+    (the manifest path stores pickled blobs).
+
+    ``overrides`` (operator-name keys, from
+    ``run_with_epochs(parallelism_overrides=...)``) authorizes named
+    replica GROUPS to restore into a DIFFERENT parallelism: the
+    group's manifest slices are merged per key (duplicate keys abort)
+    and repartitioned through the elastic ``hash % n`` owner contract,
+    so every key lands on the replica the new topology's KEYBY emitter
+    routes it to.  Groups not named by an override still require exact
+    structure."""
+    from ..durability.delta import load_into
     from ..graph.fuse import iter_logics
     loadable = {}
     for name, logic in iter_logics(graph):
@@ -124,16 +210,51 @@ def restore_states(graph, states: Dict[str, Any], describe: str,
             loadable[name] = logic
     extra = set(states) - set(loadable)
     missing = set(loadable) - set(states)
+    repartitioned = 0
+    if (extra or missing) and overrides:
+        # group mismatched names by replica prefix; an override that
+        # names a group lifts it out of the exact-match contract
+        groups = set()
+        for name in list(extra) + list(missing):
+            prefix, _idx = _replica_group(name)
+            if prefix is not None and _override_for(prefix,
+                                                    overrides):
+                groups.add(prefix)
+        for prefix in sorted(groups):
+            manifest_names = sorted(
+                n for n in states
+                if _replica_group(n)[0] == prefix)
+            group_logics = sorted(
+                ((_replica_group(n)[1], lg)
+                 for n, lg in loadable.items()
+                 if _replica_group(n)[0] == prefix),
+                key=lambda t: t[0])
+            if not manifest_names or not group_logics:
+                continue  # nothing to merge / nowhere to load
+            _repartition_group(prefix, describe, states, decode,
+                               manifest_names, group_logics)
+            repartitioned += len(group_logics)
+            extra -= set(manifest_names)
+            for n in list(missing):
+                if _replica_group(n)[0] == prefix:
+                    missing.discard(n)
+            # the group is fully restored: drop it from the exact-match
+            # load below (states entries only load via loadable keys)
+            loadable = {k: v for k, v in loadable.items()
+                        if _replica_group(k)[0] != prefix}
     if extra or missing:
         raise RuntimeError(
             f"{describe}/graph structure mismatch (e.g. different "
             "parallelism or coalesce setting than at save time): "
             f"snapshot-only nodes {sorted(extra)}, "
-            f"graph-only nodes {sorted(missing)}; nothing was restored")
+            f"graph-only nodes {sorted(missing)}; nothing was restored"
+            + ("" if overrides is None else
+               " (parallelism_overrides matched no repartitionable "
+               "group for these)"))
     for name, logic in loadable.items():
         st = states[name]
-        logic.load_state(decode(st) if decode is not None else st)
-    return len(loadable)
+        load_into(logic, decode(st) if decode is not None else st)
+    return len(loadable) + repartitioned
 
 
 def restore_graph(graph, path: str) -> int:
