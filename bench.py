@@ -1382,6 +1382,122 @@ def run_delta_snapshot_overhead(n_keys=10_000, dirty_frac=0.01,
     }
 
 
+def run_tiered_spill(n_keys=4_000, hot_frac=0.02, hot_rounds=200):
+    """Config #17: tiered keyed-state store under key explosion
+    (docs/RESILIENCE.md "Tiered state & memory pressure").  A keyed
+    accumulator folds ``n_keys`` per-key records -- a populate pass
+    touches every key once, then a hot tail revisits only the
+    ``hot_frac`` working set, the access pattern the hot/warm/cold
+    ladder is built for.  The identical workload runs twice: all-hot
+    (no ``state_budget_bytes``, every key a live object) and tiered
+    (budget ~10x smaller than the measured all-hot footprint, so most
+    keys MUST live in the pickled-warm or spilled-cold tiers).  The
+    gate holds the correctness claim: BOTH lanes' sink effects and
+    final keyed states are identical, keys actually spilled to disk,
+    the hot tail actually promoted keys back, and nothing was shed --
+    bounded memory costs throughput (pickle + segment I/O on the churn
+    path), never answers."""
+    import pickle
+    import shutil
+    import tempfile
+    import windflow_tpu as wf
+    from windflow_tpu.core import BasicRecord
+    from windflow_tpu.graph.fuse import iter_logics
+
+    n_hot = max(1, int(n_keys * hot_frac))
+    n_events = n_keys + hot_rounds * n_hot
+    tmp = tempfile.mkdtemp(prefix="windflow-tiered-bench-")
+
+    def build(budget):
+        effects = {"n": 0, "sum": 0.0}
+        state = {"i": 0}
+
+        def src(shipper, ctx=None):
+            i = state["i"]
+            if i >= n_events:
+                return False
+            k = i if i < n_keys else (i - n_keys) % n_hot
+            shipper.push(BasicRecord(k, i, i, float(i % 97)))
+            state["i"] = i + 1
+            return True
+
+        def acc(t, a):
+            a.value += t.value
+
+        def sink(r):
+            if r is not None:
+                effects["n"] += 1
+                effects["sum"] += r.value
+
+        cfg = wf.RuntimeConfig(state_budget_bytes=budget,
+                               log_dir=os.path.join(tmp, "log"))
+        g = wf.PipeGraph("bench17", wf.Mode.DEFAULT, config=cfg)
+        g.add_source(wf.SourceBuilder(src).build()) \
+            .add(wf.AccumulatorBuilder(acc)
+                 .with_initial_value(BasicRecord(value=0.0))
+                 .with_parallelism(2).build()) \
+            .add_sink(wf.SinkBuilder(sink).build())
+        return g, effects
+
+    def keyed_of(g):
+        out = {}
+        for name, lg in iter_logics(g):
+            if "accumulator" not in name:
+                continue
+            for k, v in lg.keyed_state_dict().items():
+                assert k not in out, f"key {k} materialized twice"
+                out[k] = v.value
+        return out
+
+    def lane(budget):
+        g, effects = build(budget)
+        t0 = time.perf_counter()
+        g.run()
+        dt = time.perf_counter() - t0
+        return g, n_events / dt, dict(effects), keyed_of(g)
+
+    try:
+        g_hot, rate_hot, eff_hot, state_hot = lane(None)
+        # the all-hot footprint the budget is sized against: pickled
+        # bytes per key (the tiered store's demotion currency) + slack
+        footprint = sum(len(pickle.dumps(v, pickle.HIGHEST_PROTOCOL))
+                        + 96 for v in state_hot.values())
+        budget = max(8_192, footprint // 10)
+        g_t, rate_t, eff_t, state_t = lane(budget)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    assert eff_t == eff_hot, \
+        f"tiered lane changed sink effects: {eff_t} vs {eff_hot}"
+    assert state_t == state_hot and len(state_t) == n_keys, \
+        "tiered lane materialized different keyed state"
+    stores = list((g_t.tiered_state.stores or {}).values())
+    assert stores, "tiered lane never attached tiered state"
+    spills = sum(s.spilled_keys for s in stores)
+    promotions = sum(s.promotions for s in stores)
+    spill_bytes = sum(s.spill.bytes_written for s in stores)
+    sheds = sum(s.sheds for s in stores)
+    assert spills > 0, "budget 10x under footprint yet nothing spilled"
+    assert promotions > 0, "hot tail never promoted a key back"
+    assert sheds == 0, f"{sheds} key(s) shed on an in-budget workload"
+    mem = sum(s.mem_bytes() for s in stores)
+    return {
+        "rate": round(rate_t, 1),
+        "rate_all_hot": round(rate_hot, 1),
+        "events": n_events,
+        "keys": n_keys,
+        "hot_frac": hot_frac,
+        "budget_bytes": budget,
+        "all_hot_footprint_bytes": footprint,
+        "resident_bytes": mem,
+        "spilled_keys": spills,
+        "spill_bytes": spill_bytes,
+        "promotions": promotions,
+        "sheds": sheds,
+        "results_identical": True,
+    }
+
+
 def bench12_build(g):
     """Worker-side build of config #12 (imported by the distributed
     worker processes -- keep it a pure function of env knobs): the Q5

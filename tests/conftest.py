@@ -19,3 +19,10 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: high-cardinality soaks -- deselected by the tier-1 "
+        "\"-m 'not slow'\" gate, run by the dedicated CI soak steps")

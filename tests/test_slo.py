@@ -703,7 +703,7 @@ REPORT_KEYS = {
     "Anomalies", "Anomalies_total", "Slo", "Conservation",
     "Durability", "Hot_keys", "History", "Failures", "Arbitrations",
     "Replacements", "Replica_restarts", "Recovery_fallbacks",
-    "Flight_tail",
+    "State_pressure", "Disk_full", "Flight_tail",
 }
 
 

@@ -1,0 +1,462 @@
+"""Tiered keyed-state x recovery-plane chaos proofs (state/;
+docs/RESILIENCE.md "Tiered state & memory pressure").
+
+The tier ladder must be INVISIBLE to every recovery plane built on the
+``keyed_state_dict`` contract: kill-restart mid-spill replays to the
+uninterrupted oracle, a torn spill segment is detected on read and
+healed by supervision with a fresh working set, a full disk degrades
+epoch commits without killing the graph, a supervised heal during
+delta-chain compaction neither orphans nor double-frees blobs, and the
+high-cardinality soak keeps resident bytes bounded by the budget while
+results stay exact.
+"""
+import collections
+import json
+import os
+import pickle
+import time
+
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core import BasicRecord, DurabilityConfig
+from windflow_tpu.core.basic import Pattern, RoutingMode
+from windflow_tpu.durability import (EpochStore, SupervisionConfig,
+                                     run_with_epochs)
+from windflow_tpu.operators.base import Operator, StageSpec
+from windflow_tpu.resilience import FaultPlan
+from windflow_tpu.runtime.emitters import StandardEmitter
+from windflow_tpu.runtime.node import SourceLoopLogic
+
+
+# ---------------------------------------------------------------------------
+# helpers: a WIDE offset-checkpointable source (the durability suite's
+# CkptSource folds over 4 keys -- far too few to push a store through
+# the demote/spill ladder) and its uninterrupted oracle
+# ---------------------------------------------------------------------------
+
+N_KEYS = 120
+
+
+def _val(i: int) -> float:
+    return float(i % 7)
+
+
+class _WideSourceLogic(SourceLoopLogic):
+    def __init__(self, n, pace_every=64, pace_s=0.003):
+        self.i = 0
+        self.n = n
+        self.pace_every = pace_every
+        self.pace_s = pace_s
+        super().__init__(self._step)
+
+    def _step(self, emit):
+        i = self.i
+        if i >= self.n:
+            return False
+        if self.pace_every and i % self.pace_every == 0:
+            time.sleep(self.pace_s)
+        emit(BasicRecord(i % N_KEYS, i // N_KEYS, i, _val(i)))
+        self.i = i + 1
+        return True
+
+    def state_dict(self):
+        return {"i": self.i}
+
+    def load_state(self, st):
+        self.i = st["i"]
+
+    def progress_frontier(self):
+        return self.i
+
+
+class WideSource(Operator):
+    """Offset-checkpointable paced source over N_KEYS=120 keys."""
+
+    def __init__(self, n, name="wide_source", pace_every=64,
+                 pace_s=0.003):
+        super().__init__(name, 1, RoutingMode.NONE, Pattern.SOURCE)
+        self.n = n
+        self.pace_every = pace_every
+        self.pace_s = pace_s
+
+    def stages(self):
+        logic = _WideSourceLogic(self.n, self.pace_every, self.pace_s)
+        return [StageSpec(self.name, [logic], StandardEmitter(),
+                          self.routing)]
+
+
+def _oracle(n):
+    out = collections.defaultdict(list)
+    sums = collections.defaultdict(float)
+    for i in range(n):
+        k = i % N_KEYS
+        sums[k] += _val(i)
+        out[k].append((i // N_KEYS, sums[k]))
+    return out
+
+
+def _per_key(effects):
+    got = collections.defaultdict(list)
+    for k, tid, v in effects:
+        got[k].append((tid, v))
+    return got
+
+
+def _assert_oracle(effects, n, graph, exact_ledger=True):
+    """Zero duplicate/lost effects, per-key sequences equal to the
+    uninterrupted oracle.  ``exact_ledger=False`` uses the in-place
+    heal inequality (the rewound source's replay window is discarded
+    by the epoch-aware sink, not consumed)."""
+    assert len(effects) == n, (len(effects), n)
+    assert len(set(effects)) == len(effects), "duplicate sink effects"
+    oracle = _oracle(n)
+    got = _per_key(effects)
+    assert set(got) == set(oracle)
+    for k in oracle:
+        assert got[k] == oracle[k], (k, got[k][:4], oracle[k][:4])
+    cons = json.loads(graph.stats.to_json())["Conservation"]
+    assert cons["Violations_total"] == 0, cons["Violations"]
+    assert cons["Edges_balanced"], cons
+    rhs = cons["Sinks_consumed"] + cons["Dead_letters"] \
+        + cons["Shed_tuples"]
+    if exact_ledger:
+        assert cons["Sources_emitted"] == rhs, cons
+    else:
+        assert cons["Sources_emitted"] >= rhs, cons
+
+
+def _tiered_graph(n, tmp, effects, budget, fault_plan=None, sup=None,
+                  acc_fn=None, acc_par=2, restartable=False,
+                  delta=False, interval=0.03, pace_every=48,
+                  pace_s=0.004):
+    """source -> keyed map (par 2) -> tiered keyed accumulator ->
+    transactional sink, durable, with ``state_budget_bytes`` small
+    enough that the accumulator stores run the full tier ladder."""
+    if acc_fn is None:
+        def acc_fn(t, a):
+            a.value += t.value
+
+    def sink(r):
+        if r is not None:
+            effects.append((r.key, r.id, r.value))
+
+    cfg = wf.RuntimeConfig(
+        durability=DurabilityConfig(epoch_interval_s=interval,
+                                    path=os.path.join(tmp, "epochs"),
+                                    delta=delta),
+        supervision=sup,
+        fault_plan=fault_plan,
+        state_budget_bytes=budget,
+        log_dir=os.path.join(tmp, "log"))
+    g = wf.PipeGraph("tiered_rec", wf.Mode.DEFAULT, config=cfg)
+    accb = wf.AccumulatorBuilder(acc_fn) \
+        .with_initial_value(BasicRecord(value=0.0)) \
+        .with_parallelism(acc_par)
+    if restartable:
+        accb = accb.with_restartable()
+    g.add_source(WideSource(n, pace_every=pace_every,
+                            pace_s=pace_s)) \
+        .add(wf.MapBuilder(lambda t: None).with_key_by()
+             .with_parallelism(2).build()) \
+        .add(accb.build()) \
+        .add_sink(wf.SinkBuilder(sink).with_exactly_once().build())
+    return g
+
+
+def _store_spills(g):
+    mgr = getattr(g, "tiered_state", None)
+    assert mgr is not None and mgr.stores, "tiered state never attached"
+    return sum(st.spilled_keys for st in mgr.stores.values())
+
+
+# ---------------------------------------------------------------------------
+# kill-restart mid-spill: the rerun is bitwise-equal to the oracle
+# ---------------------------------------------------------------------------
+
+def test_kill_restart_mid_spill_exactly_once(tmp_path):
+    """A replica crash while the store is actively spilling: the spill
+    directory is a runtime working set (wiped on construct), the
+    restored cut comes from epoch manifests alone, and the rerun is
+    bitwise-equal to an uninterrupted run."""
+    N = 6000
+    effects = []
+
+    def factory(attempt):
+        plan = (FaultPlan(seed=5).crash_replica("accumulator",
+                                                at_tuple=1500)
+                if attempt == 0 else None)
+        return _tiered_graph(N, str(tmp_path), effects,
+                             budget=5_000, fault_plan=plan)
+
+    g = run_with_epochs(factory, max_restarts=2)
+    assert getattr(g, "_epoch_restored", None) is not None
+    assert g._epoch_restored >= 1
+    _assert_oracle(effects, N, g)
+    # the rerun kept tiering under the same budget: real spills, no
+    # state loss (a shed key would have broken the oracle equality)
+    assert _store_spills(g) > 0
+    assert sum(st.sheds for st in g.tiered_state.stores.values()) == 0
+
+
+# ---------------------------------------------------------------------------
+# torn spill segment -> digest detection -> supervised heal
+# ---------------------------------------------------------------------------
+
+def test_torn_spill_segment_heals_under_supervision(tmp_path):
+    """A cold read of a torn segment raises (digest mismatch), the
+    supervised replica heals with a FRESH spill working set rebuilt
+    from the last committed epoch, and the run completes exactly-once
+    against the oracle."""
+    N = 6000
+    effects = []
+    cell = {}
+    torn = []
+
+    def acc(t, a):
+        a.value += t.value
+        if torn or "g" not in cell:
+            return
+        mgr = getattr(cell["g"], "tiered_state", None)
+        if mgr is None or t.id < 10:
+            return
+        for st in mgr.stores.values():
+            sp = st.spill
+            if not sp._index:
+                continue
+            key, seq = next(iter(sp._index.items()))
+            path = sp._seg_path[seq]
+            with open(path, "r+b") as f:
+                f.truncate(os.path.getsize(path) // 2)
+            sp._cache.clear()
+            torn.append(key)
+            st.get(key)  # must raise: digest mismatch on the cold read
+            raise AssertionError("torn spill segment read did not raise")
+
+    g = _tiered_graph(N, str(tmp_path), effects, budget=5_000,
+                      sup=SupervisionConfig(max_restarts=3, seed=7),
+                      acc_fn=acc, acc_par=1, restartable=True)
+    cell["g"] = g
+    g.run()
+    assert torn, "no spill segment existed to tear"
+    _assert_oracle(effects, N, g, exact_ledger=False)
+    assert g._supervisor is not None and g._supervisor.heals == 1
+    evs = [e for e in g.flight.snapshot()
+           if e["kind"] == "replica_restart"]
+    assert len(evs) == 1
+    assert "digest" in evs[0]["error"]
+    # the healed incarnation kept tiering -- and its constructor wiped
+    # the torn working set before resuming
+    assert _store_spills(g) > 0
+
+
+# ---------------------------------------------------------------------------
+# disk full mid-commit: degrade, recover, stay exact
+# ---------------------------------------------------------------------------
+
+def test_disk_full_epoch_commits_degrade_and_recover(tmp_path):
+    """Injected ENOSPC on manifest writes 2..4: those epochs abort
+    with ``epoch_abort(disk_full)`` flight events, the graph stays up
+    and degrades to the last committed epoch, and once the disk
+    'frees' the remaining commits land and release every buffered
+    sink effect exactly once.  The doctor names the incident."""
+    N = 6000
+    effects = []
+    plan = FaultPlan(seed=11).fail_write("manifest", at_write=2,
+                                         count=3)
+    g = _tiered_graph(N, str(tmp_path), effects, budget=5_000,
+                      fault_plan=plan)
+    g.run()
+    _assert_oracle(effects, N, g)
+    assert g.durability.aborts >= 1
+    evs = [e for e in g.flight.snapshot()
+           if e["kind"] == "epoch_abort"
+           and e.get("reason") == "disk_full"]
+    assert len(evs) == g.durability.aborts
+    assert all("injected" in e["error"] or "No space" in e["error"]
+               for e in evs)
+    # commits resumed past the full-disk window
+    assert g.durability.committed > max(e["epoch"] for e in evs)
+    from windflow_tpu.diagnosis.report import build_report, render_text
+    rep = build_report(json.loads(g.stats.to_json()),
+                       flight=g.flight.snapshot())
+    assert "DISK FULL" in rep["Verdict"]
+    assert "graph stayed up" in rep["Verdict"]
+    assert "tiered state & disk pressure:" in render_text(rep)
+
+
+# ---------------------------------------------------------------------------
+# supervised heal x delta-chain GC: blob refcounts stay balanced
+# ---------------------------------------------------------------------------
+
+def test_heal_during_delta_gc_keeps_blob_refcounts(tmp_path):
+    """A replica heal in a DELTA-durable tiered graph lands between
+    chain compactions and blob sweeps; afterwards every retained
+    manifest must still resolve, the blob directory must hold EXACTLY
+    the digests the retained chains reference (no orphans from the
+    abandoned incarnation, no missing links from a double-free), and
+    a further GC pass must be a no-op."""
+    N = 6000
+    effects = []
+    crashed = []
+
+    def acc(t, a):
+        if t.id == 12 and t.key == 1 and not crashed:
+            crashed.append(1)
+            raise RuntimeError("injected poison tuple")
+        a.value += t.value
+
+    g = _tiered_graph(N, str(tmp_path), effects, budget=5_000,
+                      sup=SupervisionConfig(max_restarts=3, seed=3),
+                      acc_fn=acc, restartable=True, delta=True)
+    g.run()
+    assert crashed, "poison never fired"
+    _assert_oracle(effects, N, g, exact_ledger=False)
+    assert g._supervisor is not None and g._supervisor.heals == 1
+    assert _store_spills(g) > 0
+
+    from windflow_tpu.durability.delta import chain_refs
+    store = EpochStore(os.path.join(str(tmp_path), "epochs"))
+    epochs = store._epochs_on_disk()
+    assert epochs, "no manifests survived"
+    live = set()
+    chained = 0
+    for e in epochs:
+        raw = store._load_raw(e)
+        refs = list(chain_refs(raw["states"]))
+        chained += len(refs)
+        live |= {r.digest for r in refs}
+        # every retained manifest resolves chains back to state
+        assert store.load(e)["epoch"] == e
+    assert chained, "no keyed replica rode the blob-chain path"
+    on_disk = set(store.blobs.digests_on_disk())
+    assert on_disk == live, (
+        f"orphaned={sorted(on_disk - live)[:3]} "
+        f"missing={sorted(live - on_disk)[:3]}")
+    # GC idempotency: a second sweep must not free anything referenced
+    store._gc_blobs()
+    assert set(store.blobs.digests_on_disk()) == live
+
+
+# ---------------------------------------------------------------------------
+# high-cardinality soak: bounded resident bytes, exact results
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_soak_high_cardinality_bounded_memory(tmp_path):
+    """WINDFLOW_SOAK_KEYS distinct keys (CI: 200k; the acceptance
+    figure scales to 10M) folded under a byte budget ~10x smaller
+    than the all-resident footprint: resident hot+warm bytes stay
+    bounded by the budget, the overflow rides spill segments, zero
+    tuples are lost or duplicated, and census/doctor name the tiers."""
+    n_keys = int(os.environ.get("WINDFLOW_SOAK_KEYS", 1_000_000))
+    hot_tail = 4_096          # revisits of keys 0..96: forced promotions
+    n = n_keys + hot_tail
+
+    per_key = len(pickle.dumps(
+        BasicRecord(n_keys, 0, n_keys, 0.0),
+        pickle.HIGHEST_PROTOCOL)) + 96
+    budget = max(16_384, (n_keys * per_key) // 10)
+
+    counts = [0, 0]           # effects, id-checksum
+    peak = [0]
+    sample = {}               # key -> last rolling sum seen at the sink
+    cell = {}
+
+    state = {"i": 0}
+
+    def source(shipper, ctx=None):
+        i = state["i"]
+        if i >= n:
+            return False
+        k = i if i < n_keys else (i - n_keys) % 97
+        shipper.push(BasicRecord(k, i, i, _val(i)))
+        state["i"] = i + 1
+        return True
+
+    def fold(t, a):
+        a.value += t.value
+
+    def sink(r):
+        if r is None:
+            return
+        counts[0] += 1
+        counts[1] += r.id
+        k = r.key
+        if k < 97 or k % 9_973 == 0:
+            sample[k] = r.value
+        if counts[0] % 4_096 == 0:
+            mgr = getattr(cell["g"], "tiered_state", None)
+            if mgr is not None:
+                peak[0] = max(peak[0], sum(
+                    st.mem_bytes() for st in mgr.stores.values()))
+
+    cfg = wf.RuntimeConfig(audit=True, audit_interval_s=0.1,
+                           diagnosis_interval_s=0.25,
+                           state_budget_bytes=budget,
+                           log_dir=os.path.join(str(tmp_path), "log"))
+    g = wf.PipeGraph("soak", wf.Mode.DEFAULT, config=cfg)
+    g.add_source(wf.SourceBuilder(source).build()) \
+        .add(wf.MapBuilder(lambda t: None).with_key_by()
+             .with_parallelism(2).build()) \
+        .add(wf.AccumulatorBuilder(fold)
+             .with_initial_value(BasicRecord(value=0.0))
+             .with_parallelism(2).build()) \
+        .add_sink(wf.SinkBuilder(sink).build())
+    cell["g"] = g
+    g.run()
+
+    # zero lost or duplicated tuples: the count and the id-checksum
+    # both match, and the conservation ledger balances edge by edge
+    assert counts[0] == n, (counts[0], n)
+    assert counts[1] == n * (n - 1) // 2
+    stats = json.loads(g.stats.to_json())
+    cons = stats["Conservation"]
+    assert cons["Violations_total"] == 0, cons["Violations"]
+    assert cons["Edges_balanced"], cons
+    assert cons["Sources_emitted"] == cons["Sinks_consumed"] \
+        + cons["Dead_letters"] + cons["Shed_tuples"], cons
+
+    # per-key rolling sums equal the uninterrupted oracle on the
+    # sampled keys (the hot 0..96 plus a stride across the long tail)
+    exp = collections.defaultdict(float)
+    for i in range(n_keys):
+        exp[i] += _val(i)
+    for i in range(n_keys, n):
+        exp[(i - n_keys) % 97] += _val(i)
+    for k, v in sample.items():
+        assert v == exp[k], (k, v, exp[k])
+
+    # bounded RSS from the diagnosis History gauges: the process grew
+    # by far less than the all-resident footprint the budget displaced
+    # (the overflow lives on disk, not in anonymous memory)
+    hist = (stats.get("History") or {}).get("Series") or {}
+    mem_kb = [v for v in hist.get("mem_kb", []) if v > 0]
+    assert mem_kb, "no RSS samples in the History ring"
+    growth_kb = max(mem_kb) - min(mem_kb)
+    footprint_kb = (n_keys * per_key) // 1024
+    assert growth_kb < footprint_kb, (growth_kb, footprint_kb)
+
+    # bounded memory: resident (hot+warm) bytes never exceeded ~2x a
+    # single maintenance window over the budget, while the key space
+    # itself is ~10x the budget and the overflow lives on disk
+    mgr = g.tiered_state
+    assert mgr is not None and mgr.stores
+    assert peak[0] > 0 and peak[0] <= 2 * budget, (peak[0], budget)
+    spills = sum(st.spilled_keys for st in mgr.stores.values())
+    promos = sum(st.promotions for st in mgr.stores.values())
+    sheds = sum(st.sheds for st in mgr.stores.values())
+    assert spills > n_keys // 4, spills
+    assert promos > 0, "hot-tail revisits never promoted a cold key"
+    assert sheds == 0, sheds
+
+    # census and doctor name the tiers
+    assert stats.get("Schema_version", 0) >= 9
+    rows = stats["Skew"]["Census"]
+    assert any("tiers" in r for r in rows)
+    total_keys = sum(r["keys"] for r in rows if "tiers" in r)
+    assert total_keys == n_keys
+    from windflow_tpu.diagnosis.report import build_report
+    rep = build_report(stats, flight=g.flight.snapshot())
+    hot = rep.get("Hot_keys") or []
+    assert any(h.get("tier") for h in hot), hot

@@ -1,0 +1,456 @@
+"""Tiered keyed-state store tests (windflow_tpu/state/;
+docs/RESILIENCE.md "Tiered state & memory pressure").
+
+Unit/component coverage: crash-safe spill segments (atomic-rename
+protocol, digest-named torn detection, refcounted reclamation +
+compaction), the budget watermark ladder, hot/warm/cold transitions
+under the dict contract, sketch-pinned hot keys, admission-style
+shedding with ``state_pressure`` evidence, the ``fail_write("spill")``
+ENOSPC degradation, graph-level wiring (tiered vs all-hot results
+identical, census tiers, auditor key tiers, rescale repartition over
+tiered stores) and the per-run log-dir rotation families.
+"""
+import json
+import os
+import pickle
+import threading
+import time
+import warnings
+
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core import BasicRecord
+from windflow_tpu.core.basic import RuntimeConfig, StateTierConfig
+from windflow_tpu.resilience import FaultPlan
+from windflow_tpu.resilience.policies import DeadLetterStore
+from windflow_tpu.state import SpillStore, StateBudget, TieredKeyedStore
+from windflow_tpu.telemetry.recorder import FlightRecorder
+
+
+def quiet_run(g):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        g.run()
+
+
+def _store(tmp_path, limit=4096, **kw):
+    spill = SpillStore(str(tmp_path / "spill"))
+    kw.setdefault("maintain_every", 4)
+    kw.setdefault("spill_batch", 8)
+    return TieredKeyedStore(StateBudget(limit), spill, node="t", **kw)
+
+
+# ---------------------------------------------------------------------------
+# spill segments: crash-safe format
+# ---------------------------------------------------------------------------
+
+def test_spill_roundtrip_and_segment_naming(tmp_path):
+    s = SpillStore(str(tmp_path / "sp"))
+    batch = {k: pickle.dumps(k * 2) for k in range(10)}
+    nbytes = s.put_batch(batch)
+    assert nbytes > 0 and s.bytes_written == nbytes
+    assert len(s) == 10 and 3 in s and 99 not in s
+    assert pickle.loads(s.get(3)) == 6
+    assert s.get(99) is None
+    names = [n for n in os.listdir(s.root) if n.endswith(".spill")]
+    assert len(names) == 1
+    # digest-in-name: the payload hashes to the name component
+    import hashlib
+    with open(os.path.join(s.root, names[0]), "rb") as f:
+        payload = f.read()
+    assert hashlib.sha256(payload).hexdigest() == \
+        names[0].rsplit("-", 1)[-1][:-6]
+    assert dict(s.items_pickled()) == batch
+
+
+def test_spill_torn_segment_detected_on_read(tmp_path):
+    s = SpillStore(str(tmp_path / "sp"))
+    s.put_batch({1: pickle.dumps("a"), 2: pickle.dumps("b")})
+    s._cache.clear()                 # force a disk read
+    path = next(iter(s._seg_path.values()))
+    with open(path, "r+b") as f:     # torn write: truncate in place
+        f.truncate(8)
+    with pytest.raises(RuntimeError, match="digest"):
+        s.get(1)
+
+
+def test_spill_constructor_wipes_working_set(tmp_path):
+    root = tmp_path / "sp"
+    s = SpillStore(str(root))
+    s.put_batch({1: pickle.dumps("a")})
+    (root / "orphan.tmp").write_bytes(b"half a segment")
+    # a fresh incarnation (post-crash) starts from an empty dir
+    s2 = SpillStore(str(root))
+    assert len(s2) == 0
+    assert not [n for n in os.listdir(root)
+                if n.endswith(".spill") or n.endswith(".tmp")]
+
+
+def test_spill_refcounts_and_compaction(tmp_path):
+    s = SpillStore(str(tmp_path / "sp"))
+    s.put_batch({k: pickle.dumps(k) for k in range(8)})
+    path = next(iter(s._seg_path.values()))
+    for k in range(7):
+        s.discard(k)
+    # 1/8 live is below COMPACT_LIVE_FRAC: compact rewrites survivor
+    assert s.compact() > 0
+    assert not os.path.exists(path)          # dead segment unlinked
+    assert pickle.loads(s.get(7)) == 7
+    s.discard(7)
+    # the last ref dropped: nothing left on disk
+    assert len(s) == 0
+    assert not [n for n in os.listdir(s.root) if n.endswith(".spill")]
+
+
+def test_budget_watermark_ladder():
+    b = StateBudget(1000)
+    assert (b.demote_at, b.spill_at) == (700, 850)
+    assert b.pressure(100) == "ok"
+    assert b.pressure(750) == "demote"
+    assert b.pressure(900) == "spill"
+    assert b.pressure(1001) == "shed"
+
+
+# ---------------------------------------------------------------------------
+# tier transitions under the dict contract
+# ---------------------------------------------------------------------------
+
+def test_tier_transitions_demote_spill_promote(tmp_path):
+    st = _store(tmp_path, limit=3000)
+    blob = "x" * 64
+    for k in range(40):
+        st[k] = (k, blob)
+    st.maintain()
+    tiers = {t: [k for k in range(40) if st.tier_of(k) == t]
+             for t in ("hot", "warm", "cold")}
+    assert tiers["cold"], "budget 10x under footprint yet nothing cold"
+    assert st.demotions > 0 and st.spilled_keys > 0
+    assert st.mem_bytes() <= 3000
+    # every key still answers, and a cold read promotes
+    k_cold = tiers["cold"][0]
+    assert st[k_cold] == (k_cold, blob)
+    assert st.tier_of(k_cold) == "hot"
+    assert st.promotions >= 1
+    # dict surface: len/iter/contains see all tiers
+    assert len(st) == 40
+    assert sorted(st.keys()) == list(range(40))
+    assert all(k in st for k in range(40))
+    assert dict(st.items()) == {k: (k, blob) for k in range(40)}
+    # delete from a cold tier
+    k_cold2 = next(k for k in range(40) if st.tier_of(k) == "cold")
+    del st[k_cold2]
+    assert k_cold2 not in st and len(st) == 39
+    with pytest.raises(KeyError):
+        st[k_cold2]
+    assert st.pop(k_cold2, "dflt") == "dflt"
+
+
+def test_sketch_pinned_keys_stay_hot(tmp_path):
+    st = _store(tmp_path, limit=2000)
+    st.bind_hot_sketch(lambda: {0, 1})
+    for k in range(50):
+        st[k] = "v" * 100
+        st.get(0), st.get(1)          # keep the pinned keys LRU-warm
+    st.maintain()
+    assert st.tier_of(0) == "hot" and st.tier_of(1) == "hot"
+    assert any(st.tier_of(k) in ("warm", "cold") for k in range(2, 50))
+
+
+def test_shed_past_budget_degrades_with_evidence(tmp_path):
+    flight = FlightRecorder(64)
+    dead = DeadLetterStore()
+    spill = SpillStore(str(tmp_path / "sp"))
+    st = TieredKeyedStore(StateBudget(1500), spill, node="acc.0",
+                          flight=flight, dead_letters=dead,
+                          maintain_every=4, spill_batch=8)
+    # a full spill disk forces the ladder past demote/spill into shed
+    st.spill.fault_plan = FaultPlan(seed=1).fail_write(
+        "spill", at_write=1, count=10_000)
+    for k in range(60):
+        st[k] = "v" * 200
+    st.maintain()
+    assert st.mem_bytes() <= 1500 + 500   # bounded, never an OOM climb
+    assert st.sheds > 0
+    assert dead.count() == st.sheds
+    kinds = [e["kind"] for e in flight.snapshot()]
+    assert "spill_abort" in kinds and "state_pressure" in kinds
+    ev = next(e for e in flight.snapshot()
+              if e["kind"] == "state_pressure")
+    assert ev["node"] == "acc.0" and ev["shed"] >= 1
+    assert ev["budget"] == 1500
+
+
+def test_spill_abort_rewarns_batch_and_backs_off(tmp_path):
+    flight = FlightRecorder(64)
+    # tiny demote/spill watermarks under a roomy hard limit: spill
+    # pressure without shed pressure, so the failed write must leave
+    # every key intact in memory
+    st = TieredKeyedStore(StateBudget(100_000, demote_frac=0.02,
+                                      spill_frac=0.03),
+                          SpillStore(str(tmp_path / "sp")),
+                          node="acc.0", flight=flight,
+                          maintain_every=4, spill_batch=8)
+    # first spill write fails, later ones succeed
+    st.spill.fault_plan = FaultPlan(seed=1).fail_write("spill",
+                                                       at_write=1)
+    for k in range(30):
+        st[k] = b"v" * 200
+    st.maintain()
+    aborted = [e for e in flight.snapshot() if e["kind"] == "spill_abort"]
+    assert aborted and aborted[0]["keys"] >= 1
+    # no key was lost to the failed write: the batch re-warmed
+    assert len(st) == 30 and st.sheds == 0
+    assert all(st.get(k) == b"v" * 200 for k in range(30))
+    # the cooldown expires and the next writes land on disk
+    for _ in range(20):
+        st.maintain()
+    assert st.spilled_keys > 0 and len(st) == 30
+
+
+def test_replace_all_wipes_every_tier(tmp_path):
+    st = _store(tmp_path, limit=2000)
+    for k in range(40):
+        st[k] = "v" * 100
+    st.maintain()
+    assert len(st.spill) > 0
+    st.replace_all({"a": 1, "b": 2})
+    assert dict(st.items()) == {"a": 1, "b": 2}
+    assert len(st.spill) == 0
+    assert not [n for n in os.listdir(st.spill.root)
+                if n.endswith(".spill")]
+    st.clear()
+    assert len(st) == 0 and not st
+
+
+def test_keyed_state_pickled_reuses_stored_bytes(tmp_path):
+    """The "cold tier by reference" property: warm/cold keys serve
+    their STORED pickled bytes, so an unchanged key digests
+    identically across epoch captures."""
+    st = _store(tmp_path, limit=2000)
+    for k in range(40):
+        st[k] = (k, "v" * 80)
+    st.maintain()
+    first = st.keyed_state_pickled()
+    second = st.keyed_state_pickled()
+    assert first == second
+    assert set(first) == set(range(40))
+    # and the bytes decode to the live values
+    assert all(pickle.loads(vb) == (k, "v" * 80)
+               for k, vb in first.items())
+
+
+def test_census_names_tiers_and_counters(tmp_path):
+    st = _store(tmp_path, limit=2000)
+    for k in range(40):
+        st[k] = "v" * 100
+    st.maintain()
+    total, mem, extras = st.census()
+    assert total == 40
+    t = extras["tiers"]
+    assert t["hot"][0] + t["warm"][0] + t["cold"][0] == 40
+    assert mem == t["hot"][1] + t["warm"][1]
+    assert extras["spills"] == st.spilled_keys
+    assert extras["spill_bytes"] == st.spill.bytes_written
+    assert t["cold"][1] == st.spill.disk_bytes()
+    assert extras["sheds"] == 0
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan.fail_write clocks
+# ---------------------------------------------------------------------------
+
+def test_fail_write_windows_and_validation():
+    fp = FaultPlan(seed=1).fail_write("spill", at_write=2, count=2)
+    assert [fp.write_should_fail("spill") for _ in range(5)] == \
+        [False, True, True, False, False]
+    # independent per-kind clocks
+    fp2 = FaultPlan(seed=1).fail_write("manifest", at_write=1)
+    assert fp2.write_should_fail("blob") is False
+    assert fp2.write_should_fail("manifest") is True
+    with pytest.raises(ValueError):
+        FaultPlan().fail_write("nonsense")
+
+
+# ---------------------------------------------------------------------------
+# graph-level wiring
+# ---------------------------------------------------------------------------
+
+def _keyed_graph(n, n_keys, budget, sunk, log_dir, audit=True,
+                 tiers=None, par=2):
+    state = {"i": 0}
+
+    def src(shipper, ctx=None):
+        i = state["i"]
+        if i >= n:
+            return False
+        shipper.push(BasicRecord(i % n_keys, i // n_keys, i, float(i)))
+        state["i"] = i + 1
+        return True
+
+    def fold(t, a):
+        a.value += t.value
+
+    cfg = RuntimeConfig(audit=audit, audit_interval_s=0.05,
+                        state_budget_bytes=budget, state_tiers=tiers,
+                        log_dir=log_dir)
+    g = wf.PipeGraph("tiers", wf.Mode.DEFAULT, config=cfg)
+    g.add_source(wf.SourceBuilder(src).build()) \
+        .add(wf.AccumulatorBuilder(fold)
+             .with_initial_value(BasicRecord(value=0.0))
+             .with_parallelism(par).build()) \
+        .add_sink(wf.SinkBuilder(
+            lambda r: sunk.append((r.key, r.id, r.value))
+            if r is not None else None).build())
+    return g
+
+
+def test_tiered_graph_matches_all_hot_and_reports_tiers(tmp_path):
+    n, n_keys = 20_000, 400
+    base, tiered = [], []
+    quiet_run(_keyed_graph(n, n_keys, None, base,
+                           str(tmp_path / "a")))
+    g = _keyed_graph(n, n_keys, 30_000, tiered, str(tmp_path / "b"))
+    quiet_run(g)
+    # bounded memory changed no answers
+    assert sorted(tiered) == sorted(base) and len(tiered) == n
+    assert g.tiered_state is not None
+    stores = list(g.tiered_state.stores.values())
+    assert stores and sum(s.spilled_keys for s in stores) > 0
+    assert sum(s.sheds for s in stores) == 0
+    # census rows carry the per-tier splits (schema 9)
+    rep = json.loads(g.stats.to_json())
+    assert rep["Schema_version"] >= 9
+    rows = (rep.get("Skew") or {}).get("Census") or []
+    assert rows and all("tiers" in r for r in rows)
+    for r in rows:
+        t = r["tiers"]
+        assert t["hot"][0] + t["warm"][0] + t["cold"][0] == r["keys"]
+    # the auditor names each sketch-hot key's tier
+    assert g.auditor is not None
+    tiers = g.auditor.key_tiers.get("pipe0/accumulator") or {}
+    assert tiers and set(tiers.values()) <= {"hot", "warm", "cold"}
+    # sketch-pinned hot keys stay hot in SOME replica (round-robin
+    # keys: each hot key lives in exactly one replica's store)
+    assert "hot" in set(tiers.values())
+
+
+def test_state_tier_config_knobs(tmp_path):
+    sunk = []
+    # audit off: the sketch would pin its top-16 keys hot, a floor the
+    # tighter hot_max_keys knob cannot undercut
+    g = _keyed_graph(6_000, 100, 20_000, sunk, str(tmp_path / "l"),
+                     audit=False,
+                     tiers=StateTierConfig(hot_max_keys=5,
+                                           maintain_every=8,
+                                           spill_batch=16))
+    quiet_run(g)
+    assert len(sunk) == 6_000
+    for s in g.tiered_state.stores.values():
+        # enforced at maintain boundaries: between two maintains at
+        # most maintain_every admissions can overshoot the cap
+        assert len(s._hot) <= 5 + 8
+        assert s.spill_batch == 16 and s.maintain_every == 8
+
+
+def test_rescale_repartitions_tiered_state(tmp_path):
+    """Live 1->3->2 rescale of a tiered keyed fold: keys re-hash to the
+    new owners (hash % n), retired replicas release their spill dirs,
+    new replicas get tiered stores, and no tuple is lost."""
+    n, n_keys = 12_000, 300
+    state = {"i": 0}
+    sunk = []
+    lock = threading.Lock()
+
+    def src(shipper, ctx=None):
+        i = state["i"]
+        if i >= n:
+            return False
+        if i % 64 == 0:
+            time.sleep(0.001)
+        shipper.push(BasicRecord(i % n_keys, i // n_keys, i, 1.0))
+        state["i"] = i + 1
+        return True
+
+    def fold(t, a):
+        a.value += t.value
+
+    def sink(r):
+        if r is not None:
+            with lock:
+                sunk.append((r.key, r.id, r.value))
+
+    cfg = RuntimeConfig(state_budget_bytes=20_000,
+                        log_dir=str(tmp_path / "log"))
+    g = wf.PipeGraph("tiers_rescale", wf.Mode.DEFAULT, config=cfg)
+    g.add_source(wf.SourceBuilder(src).build()) \
+        .add(wf.AccumulatorBuilder(fold)
+             .with_initial_value(BasicRecord(value=0.0))
+             .with_name("acc").with_elasticity(1, 3).build()) \
+        .add_sink(wf.SinkBuilder(sink).build())
+    g.start()
+    deadline = time.monotonic() + 30
+    while state["i"] < n // 3:
+        assert time.monotonic() < deadline
+        time.sleep(0.002)
+    g.rescale("acc", 3)
+    assert len(g.tiered_state.stores) == 3
+    while state["i"] < 2 * n // 3:
+        assert time.monotonic() < deadline
+        time.sleep(0.002)
+    g.rescale("acc", 2)
+    # the retired replica's store was released (spill segments freed)
+    assert len(g.tiered_state.stores) == 2
+    g.wait_end()
+    assert len(sunk) == n
+    # per-key final sums match the oracle (value 1.0 per tuple)
+    finals = {}
+    for k, _i, v in sunk:
+        finals[k] = max(v, finals.get(k, 0.0))
+    assert finals == {k: float(len([i for i in range(n)
+                                    if i % n_keys == k]))
+                      for k in range(n_keys)}
+
+
+# ---------------------------------------------------------------------------
+# log-dir rotation families
+# ---------------------------------------------------------------------------
+
+def test_rotate_snapshots_prunes_per_family(tmp_path):
+    from windflow_tpu.monitoring.monitor import rotate_snapshots
+    d = str(tmp_path)
+    fams = ("_stats.json", "_flight.jsonl", "_runtime.json",
+            ".json", ".dot", ".svg")
+    for i in range(5):
+        for fam in fams:
+            p = os.path.join(d, f"{i}_g{fam}")
+            with open(p, "w") as f:
+                f.write("{}")
+            os.utime(p, (i, i))       # deterministic mtime order
+    (tmp_path / "stall_report.txt").write_text("keep me")
+    rotate_snapshots(d, keep=2)
+    for fam in fams:
+        left = sorted(n for n in os.listdir(d) if n.endswith(fam)
+                      and not any(n.endswith(o) for o in fams
+                                  if o != fam and len(o) > len(fam)))
+        assert left == [f"3_g{fam}", f"4_g{fam}"], (fam, left)
+    # unrecognized files stay; keep<=0 disables rotation
+    assert (tmp_path / "stall_report.txt").exists()
+    rotate_snapshots(d, keep=0)
+    assert (tmp_path / "4_g_stats.json").exists()
+
+
+def test_flight_dump_participates_in_rotation(tmp_path):
+    d = str(tmp_path)
+    for i in range(4):
+        p = os.path.join(d, f"{i}_old_flight.jsonl")
+        with open(p, "w") as f:
+            f.write("{}\n")
+        os.utime(p, (i, i))
+    fr = FlightRecorder(16)
+    fr.record("x", a=1)
+    path = fr.dump(d, "g", keep=2)
+    assert path is not None
+    left = sorted(n for n in os.listdir(d)
+                  if n.endswith("_flight.jsonl"))
+    assert len(left) == 2 and os.path.basename(path) in left

@@ -453,6 +453,12 @@ def test_openmetrics_renderer_unit():
             "PipeGraph_name": 'g"1\\x',
             "Dropped_tuples": 3, "Dead_letter_tuples": 1, "Rescales": 2,
             "Memory_usage_KB": 10,
+            "Skew": {"Census": [
+                {"replica": "pipe0/map_0", "keys": 5, "bytes_est": 100,
+                 "tiers": {"hot": [2, 60], "warm": [2, 30],
+                           "cold": [1, 10]},
+                 "spills": 4, "spill_bytes": 10}],
+                "Hot_keys": []},
             "Latency_e2e": {"n": 3, "sum_us": 600.0,
                             "buckets": [[100.0, 2], [-1.0, 1]]},
             "Operators": [{
@@ -479,6 +485,13 @@ def test_openmetrics_renderer_unit():
     assert 'le="+Inf"} 3' in text
     assert "windflow_e2e_latency_seconds_sum" in text
     assert 'windflow_dropped_tuples_total' in text
+    # tiered keyed-state families (state/tiers.py census extras):
+    # per-tier byte gauge + spill counter, labelled by replica
+    assert 'windflow_keyed_state_bytes{app="1",graph="g\\"1\\\\x",' \
+        'replica="pipe0/map_0",tier="hot"} 60' in text
+    assert 'tier="cold"} 10' in text
+    assert 'windflow_state_spills_total{app="1",graph="g\\"1\\\\x",' \
+        'replica="pipe0/map_0"} 4' in text
     # EVERY histogram closes with the mandatory +Inf bucket, even when
     # the sparse buckets already sum to n (histogram_quantile needs it)
     lines = text.splitlines()

@@ -132,6 +132,16 @@ def measure() -> tuple:
     assert r16["commit_bytes"]["ratio"] >= 10, \
         f"delta commit ratio {r16['commit_bytes']['ratio']} < 10x"
     out["16_delta_snapshot"] = r16["rate"]
+    # tiered keyed-state smoke (docs/RESILIENCE.md "Tiered state &
+    # memory pressure"): the helper itself asserts identical sink
+    # effects + keyed state between the tiered (budget 10x under the
+    # all-hot footprint) and all-hot lanes, that keys actually spilled
+    # and promoted back, and that nothing was shed; the gated rate
+    # catches a serialized/wedged demote-spill-promote path
+    r17 = bench.run_tiered_spill()
+    assert r17["results_identical"] and r17["sheds"] == 0
+    out["17_tiered_spill"] = r17["rate"]
+    out["17_all_hot"] = r17["rate_all_hot"]
     for q in ("q5", "q7"):
         # per-query warmup: each query's engine ('count'/'max') XLA-
         # compiles on first launch; without this the compile lands in

@@ -50,6 +50,9 @@ class GraphAuditor(threading.Thread):
         self._sketches: List[tuple] = []
         self.op_skew: Dict[str, dict] = {}
         self.census_rows: List[dict] = []
+        # op -> {str(key): tier name} for the sketch's hot keys, probed
+        # from the owning logics' state_tier_of each skew refresh
+        self.key_tiers: Dict[str, Dict[str, str]] = {}
 
     # -- wiring (PipeGraph.start / elastic rescale) --------------------
     def attach(self) -> None:
@@ -62,6 +65,7 @@ class GraphAuditor(threading.Thread):
     def attach_node(self, node) -> None:
         self.ledger.attach_node(node)
         self._attach_sketches(node)
+        self._bind_hot_keys(node)
 
     def _attach_sketches(self, node) -> None:
         from .ledger import unwrap
@@ -85,6 +89,34 @@ class GraphAuditor(threading.Thread):
                     dest_op = _op_of(c.name)
                     break
             self._sketches.append((dest_op or node.name, em.key_sketch))
+
+    def _bind_hot_keys(self, node) -> None:
+        """Hand the hot-key sketch to this node's keyed stores (tiered
+        state, state/tiers.py): the merged top-K of the sketches
+        feeding the node's operator becomes the store's pinned-hot key
+        set, so the keys the audit plane currently names hot are never
+        demoted off the fast tier."""
+        from ..runtime.node import FusedLogic
+
+        def bind(logic, name):
+            fn = getattr(logic, "bind_hot_sketch", None)
+            if fn is None:
+                return
+            op = _op_of(name)
+
+            def hot_keys(op=op):
+                keys = set()
+                for o, sk in self._sketches:
+                    if o == op:
+                        keys.update(sk.counts)
+                return keys
+            fn(hot_keys)
+
+        if isinstance(node.logic, FusedLogic):
+            for seg in node.logic.segments:
+                bind(seg.logic, seg.name)
+        else:
+            bind(node.logic, node.name)
 
     def fold_retired(self, node) -> None:
         """Elastic scale-down accounting (called by rescale before the
@@ -163,8 +195,9 @@ class GraphAuditor(threading.Thread):
 
     def _refresh_skew(self, nodes) -> None:
         self.census_rows = take_census(nodes)
+        merged = self._merged_sketches()
         skew: Dict[str, dict] = {}
-        for op, agg in self._merged_sketches().items():
+        for op, agg in merged.items():
             if not agg["observed"] or not agg["counts"]:
                 continue
             key, cnt = max(agg["counts"].items(), key=lambda kv: kv[1])
@@ -173,6 +206,44 @@ class GraphAuditor(threading.Thread):
             skew[op] = {"share": round(share, 4), "key": key,
                         "observed": agg["observed"]}
         self.op_skew = skew
+        self.key_tiers = self._probe_tiers(nodes, merged)
+
+    def _probe_tiers(self, nodes, merged: Dict[str, dict]
+                     ) -> Dict[str, Dict[str, str]]:
+        """Which tier each sketch-reported hot key lives in, probed
+        from the owning logics' ``state_tier_of`` (gauge-grade, like
+        the census): tiered stores answer hot/warm/cold, the
+        device-resident engines answer "device"."""
+        from ..runtime.node import FusedLogic
+        out: Dict[str, Dict[str, str]] = {}
+
+        def probe(logic, name):
+            fn = getattr(logic, "state_tier_of", None)
+            if fn is None:
+                return
+            op = _op_of(name)
+            agg = merged.get(op)
+            if agg is None:
+                return
+            tiers = out.setdefault(op, {})
+            for k in agg["counts"]:
+                sk = str(k)
+                if sk in tiers:
+                    continue  # another replica already owns it
+                try:
+                    t = fn(k)
+                except Exception:
+                    t = None
+                if t is not None:
+                    tiers[sk] = t
+
+        for n in nodes:
+            if isinstance(n.logic, FusedLogic):
+                for seg in n.logic.segments:
+                    probe(seg.logic, seg.name)
+            else:
+                probe(n.logic, n.name)
+        return out
 
     def skew_of(self, op_name: str) -> float:
         """Top-key share signal for the elastic plane (0.0 = unknown)."""
@@ -189,8 +260,13 @@ class GraphAuditor(threading.Thread):
             top = [[k, c, agg["errs"].get(k, 0)] for k, c in rows]
             info = self.op_skew.get(op)
             share = info["share"] if info else 0.0
-            hot.append({"operator": op, "share": share,
-                        "observed": agg["observed"], "top": top})
+            entry = {"operator": op, "share": share,
+                     "observed": agg["observed"], "top": top}
+            tiers = self.key_tiers.get(op)
+            if tiers:
+                entry["tiers"] = {str(k): tiers[str(k)] for k, _c in rows
+                                  if str(k) in tiers}
+            hot.append(entry)
         return {"Census": self.census_rows, "Hot_keys": hot}
 
     def _publish(self, edges, nodes) -> None:

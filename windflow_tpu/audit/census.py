@@ -98,7 +98,11 @@ class SpaceSavingSketch:
 
 def take_census(nodes) -> List[dict]:
     """Per-replica keyed-state rows from the ``keyed_state_census``
-    hooks (fused nodes report per segment under original names)."""
+    hooks (fused nodes report per segment under original names).  A
+    hook may return ``(keys, bytes)`` or -- tiered stores
+    (state/tiers.py) -- ``(keys, bytes, extras)`` where ``extras``
+    carries per-tier splits and spill/promotion/shed counters that
+    land verbatim on the row."""
     from ..runtime.node import FusedLogic
     rows: List[dict] = []
 
@@ -112,9 +116,12 @@ def take_census(nodes) -> List[dict]:
             return
         if got is None:
             return
-        keys, nbytes = got
-        rows.append({"replica": name, "keys": int(keys),
-                     "bytes_est": int(nbytes)})
+        keys, nbytes = got[0], got[1]
+        row = {"replica": name, "keys": int(keys),
+               "bytes_est": int(nbytes)}
+        if len(got) > 2 and isinstance(got[2], dict):
+            row.update(got[2])
+        rows.append(row)
 
     for n in nodes:
         if isinstance(n.logic, FusedLogic):

@@ -205,6 +205,27 @@ class DurabilityConfig:
 
 
 @dataclass(frozen=True)
+class StateTierConfig:
+    """Tiered keyed-state tuning (state/; docs/RESILIENCE.md "Tiered
+    state & memory pressure").  Only consulted when
+    ``RuntimeConfig.state_budget_bytes`` is set; the defaults are the
+    tested operating point, so most graphs never touch this."""
+
+    # budget fractions where demotion (hot -> warm pickles) and disk
+    # spill (warm -> cold segments) start; past the budget itself the
+    # store SHEDS coldest keys into dead_letters (state_pressure)
+    demote_frac: float = 0.7
+    spill_frac: float = 0.85
+    # optional hard cap on live hot objects per replica (None = bytes
+    # budget only)
+    hot_max_keys: Optional[int] = None
+    # store operations between maintenance passes on the replica thread
+    maintain_every: int = 64
+    # cold keys per spill segment file
+    spill_batch: int = 256
+
+
+@dataclass(frozen=True)
 class SupervisionConfig:
     """Replica self-healing policy (durability/supervision.py;
     docs/RESILIENCE.md "Supervised replica restart").
@@ -371,6 +392,21 @@ class RuntimeConfig:
     # idempotent sink contract (SinkBuilder.with_exactly_once).  None
     # (the default) keeps the pre-durability hot path untouched.
     durability: Any = None
+    # -- tiered keyed state (state/; docs/RESILIENCE.md "Tiered state
+    # & memory pressure") -----------------------------------------------
+    # hard per-graph budget for in-memory keyed state, split evenly
+    # across the replicas whose logics expose enable_tiered_state
+    # (AccumulatorLogic today).  Approaching a replica's share demotes
+    # LRU keys to pickled host bytes, then spills the oldest to
+    # crash-safe disk segments under <log_dir>/state_spill/; past the
+    # hard ceiling the coldest keys are SHED into dead_letters with a
+    # state_pressure flight event -- degraded and loud, never an
+    # allocator crash.  None (the default) keeps every keyed store a
+    # plain in-memory dict (the pre-tiering hot path).
+    state_budget_bytes: Optional[int] = None
+    # StateTierConfig tuning the watermarks/batching, or None for the
+    # defaults
+    state_tiers: Any = None
     # SupervisionConfig arming supervised replica self-healing for
     # operators marked .with_restartable(): replica crashes there are
     # healed in place from the last committed epoch instead of failing

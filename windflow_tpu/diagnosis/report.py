@@ -49,10 +49,19 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
             "Final_check": bool(cons.get("Final_check")),
         }
     skew = stats.get("Skew") or {}
-    hot = [{"operator": h.get("operator"),
-            "share": h.get("share"), "key": (h.get("top") or [[None]])[0][0]}
-           for h in (skew.get("Hot_keys") or [])
-           if (h.get("share") or 0) > 0]
+    hot = []
+    for h in (skew.get("Hot_keys") or []):
+        if not (h.get("share") or 0) > 0:
+            continue
+        key = (h.get("top") or [[None]])[0][0]
+        entry = {"operator": h.get("operator"),
+                 "share": h.get("share"), "key": key}
+        # tiered stores name the tier holding each hot key
+        # (auditor._probe_tiers); absent on non-tiered graphs
+        tier = (h.get("tiers") or {}).get(str(key))
+        if tier is not None:
+            entry["tier"] = tier
+        hot.append(entry)
     hist = stats.get("History") or {}
     series = hist.get("Series") or {}
     history = None
@@ -130,6 +139,29 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
         "reason": e.get("reason"),
     } for e in flight if e.get("kind") == "epoch_abort"
         and e.get("reason") in ("manifest_corrupt", "blob_missing")]
+    # tiered keyed state (state/; docs/RESILIENCE.md "Tiered state &
+    # memory pressure"): admission-control sheds under the byte budget
+    # and spill batches re-warmed by a full disk
+    pressure = [{
+        "t": e.get("t"),
+        "kind": e.get("kind"),
+        "node": e.get("node"),
+        "shed": e.get("shed"),
+        "keys": e.get("keys"),
+        "budget": e.get("budget"),
+        "mem_bytes": e.get("mem_bytes"),
+        "error": e.get("error"),
+    } for e in flight if e.get("kind") in ("state_pressure",
+                                           "spill_abort")]
+    # disk-full epoch aborts (durability/coordinator.py): the commit
+    # degraded -- last committed epoch kept, graph stayed up
+    disk_full = [{
+        "t": e.get("t"),
+        "epoch": e.get("epoch"),
+        "final": e.get("final"),
+        "error": e.get("error"),
+    } for e in flight if e.get("kind") == "epoch_abort"
+        and e.get("reason") == "disk_full"]
     dur = stats.get("Durability")
     durability = None
     if dur:
@@ -163,6 +195,8 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
         "Replacements": replacements[-FLIGHT_TAIL:],
         "Replica_restarts": heals[-FLIGHT_TAIL:],
         "Recovery_fallbacks": fallbacks[-FLIGHT_TAIL:],
+        "State_pressure": pressure[-FLIGHT_TAIL:],
+        "Disk_full": disk_full[-FLIGHT_TAIL:],
         "Flight_tail": list(flight)[-FLIGHT_TAIL:],
     }
     report["Verdict"] = _verdict(report)
@@ -194,6 +228,25 @@ def _verdict(report: dict) -> str:
         parts.append(f"epochs STALLED (committed "
                      f"{dur['Committed_epoch']}, oldest uncommitted "
                      f"{dur['Epoch_lag_s']:.1f}s old)")
+    disk_full = report.get("Disk_full") or []
+    if disk_full:
+        last = disk_full[-1]
+        parts.append(f"DISK FULL: {len(disk_full)} epoch commit(s) "
+                     f"aborted, degraded to last committed epoch "
+                     f"(graph stayed up; last abort at epoch "
+                     f"{last.get('epoch')})")
+    pressure = report.get("State_pressure") or []
+    sheds = [p for p in pressure if p.get("kind") == "state_pressure"]
+    if sheds:
+        dropped = sum(int(p.get("shed") or 0) for p in sheds)
+        parts.append(f"STATE PRESSURE: {dropped} key(s) shed to the "
+                     f"dead-letter store under the byte budget "
+                     f"(last at {sheds[-1].get('node')})")
+    spill_aborts = [p for p in pressure if p.get("kind") == "spill_abort"]
+    if spill_aborts:
+        parts.append(f"{len(spill_aborts)} spill batch(es) re-warmed "
+                     f"in memory (spill disk full at "
+                     f"{spill_aborts[-1].get('node')})")
     heals = report.get("Replica_restarts") or []
     if heals:
         if any(h.get("outcome") == "escalated" for h in heals):
@@ -399,10 +452,30 @@ def render_text(report: dict) -> str:
             out.append(f"  [{e.get('t')}] epoch {e.get('epoch')} "
                        f"unreadable ({e.get('reason')}) -- fell back "
                        f"to an older fully-loadable cut")
+    pressure = report.get("State_pressure") or []
+    disk_full = report.get("Disk_full") or []
+    if pressure or disk_full:
+        out.append("")
+        out.append("tiered state & disk pressure:")
+        for e in disk_full:
+            out.append(f"  [{e.get('t')}] epoch {e.get('epoch')} commit "
+                       f"aborted: disk full -- kept last committed "
+                       f"epoch, graph stayed up ({e.get('error')})")
+        for e in pressure:
+            if e.get("kind") == "state_pressure":
+                out.append(f"  [{e.get('t')}] {e.get('node')}: shed "
+                           f"{e.get('shed')} key(s) to dead letters "
+                           f"(mem {e.get('mem_bytes')}B over budget "
+                           f"{e.get('budget')}B)")
+            else:
+                out.append(f"  [{e.get('t')}] {e.get('node')}: spill "
+                           f"batch of {e.get('keys')} key(s) re-warmed "
+                           f"-- spill disk full ({e.get('error')})")
     hot = report.get("Hot_keys") or []
     if hot:
         out.append("hot keys: " + ", ".join(
             f"{h['operator']} key={h['key']} share={h['share']}"
+            + (f" tier={h['tier']}" if h.get("tier") else "")
             for h in hot[:4]))
     hist = report.get("History")
     if hist:

@@ -51,6 +51,8 @@ class EpochCoordinator(threading.Thread):
         self.interval_s = max(0.005, float(dcfg.epoch_interval_s))
         self.stall_s = max(self.interval_s * float(dcfg.stall_factor), 0.5)
         self.store = EpochStore(dcfg.path, dcfg.retained)
+        # FaultPlan.fail_write("manifest"/"blob") injection point
+        self.store.fault_plan = getattr(graph.config, "fault_plan", None)
         # incremental snapshots (durability/delta.py): keyed replicas
         # capture per-key and this thread's encoders turn each capture
         # into content-addressed blob chains, O(changed keys) per commit
@@ -416,10 +418,24 @@ class EpochCoordinator(threading.Thread):
                     f"injected torn manifest commit at epoch {epoch}"),
                 origin="epoch-coordinator")
             return
-        path, nbytes = self.store.commit(
-            epoch, states, offsets,
-            meta={"graph": g.name, "committed_at": _time.time()},
-            blob_writes=blob_writes)
+        try:
+            path, nbytes = self.store.commit(
+                epoch, states, offsets,
+                meta={"graph": g.name, "committed_at": _time.time()},
+                blob_writes=blob_writes)
+        except OSError as e:
+            # disk full (or any filesystem refusal) mid-commit: degrade,
+            # do not die.  The last committed epoch stays the recovery
+            # point, transactional sinks keep buffering until a later
+            # commit succeeds, and the delta encoders reset so the next
+            # epoch writes a fresh base chain -- their shadows may
+            # reference blobs this commit never made durable.
+            self.aborts += 1
+            self._encoders.clear()
+            g.flight.record("epoch_abort", epoch=epoch,
+                            reason="disk_full", error=str(e),
+                            committed=self.committed)
+            return
         self.delta_bytes = nbytes
         g.flight.record("checkpoint_epoch", epoch=epoch, path=path,
                         replicas=len(states), bytes=nbytes)
@@ -656,6 +672,15 @@ class EpochCoordinator(threading.Thread):
             self.commits += 1
             self.last_manifest = {"epoch": epoch, "states": states,
                                   "offsets": {}}
+        except OSError as e:
+            # disk full at the final manifest: the run's OUTPUT is
+            # complete either way (the finally below still releases the
+            # sinks); only a later restart loses this last rewind point
+            self.aborts += 1
+            self._encoders.clear()
+            g.flight.record("epoch_abort", epoch=epoch,
+                            reason="disk_full", error=str(e),
+                            committed=self.committed, final=True)
         finally:
             # the stream completed either way: the buffered effects ARE
             # the output (a failed manifest write only affects restarts

@@ -116,6 +116,15 @@ class KeyedCapture:
 
     @classmethod
     def capture(cls, logic) -> "KeyedCapture":
+        # tiered stores (state/tiers.py) serve warm/cold keys from the
+        # pickled bytes they already hold -- unchanged cold keys digest
+        # identically every epoch, so the chain references them with
+        # zero new blob bytes ("cold tier by reference")
+        fast = getattr(logic, "keyed_state_pickled", None)
+        if fast is not None:
+            got = fast()
+            if got is not None:
+                return cls(dict(got))
         return cls({k: pickle.dumps(v, protocol=pickle.HIGHEST_PROTOCOL)
                     for k, v in logic.keyed_state_dict().items()})
 
@@ -135,10 +144,15 @@ class BlobStore:
     def path(self, digest: str) -> str:
         return os.path.join(self.root, f"{digest}.blob")
 
-    def write(self, digest: str, payload: bytes) -> str:
+    def write(self, digest: str, payload: bytes, fault_plan=None) -> str:
         from .store import atomic_write_bytes
         p = self.path(digest)
         if not os.path.exists(p):
+            if fault_plan is not None \
+                    and fault_plan.write_should_fail("blob"):
+                import errno
+                raise OSError(errno.ENOSPC,
+                              "injected disk full (epoch blob)")
             os.makedirs(self.root, exist_ok=True)
             atomic_write_bytes(p, payload)
         return p

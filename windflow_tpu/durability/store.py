@@ -108,6 +108,7 @@ class EpochStore:
         self.dir = path
         self.retained = max(1, int(retained))
         self.blobs = BlobStore(os.path.join(path, "blobs"))
+        self.fault_plan = None   # FaultPlan.fail_write (set at attach)
         os.makedirs(self.dir, exist_ok=True)
 
     def manifest_path(self, epoch: int) -> str:
@@ -135,10 +136,11 @@ class EpochStore:
         written for this epoch: manifest + fresh blobs).  ``blob_writes``
         (digest -> payload) land BEFORE the manifest so a crash between
         the two leaves an unreferenced blob, never a dangling chain."""
+        fp = self.fault_plan
         nbytes = 0
         if blob_writes:
             for digest, payload_b in blob_writes.items():
-                self.blobs.write(digest, payload_b)
+                self.blobs.write(digest, payload_b, fault_plan=fp)
                 nbytes += len(payload_b)
         chains = any(isinstance(v, dict) and "keyed_chain" in v
                      for v in states.values())
@@ -148,6 +150,10 @@ class EpochStore:
                    "offsets": dict(offsets), "meta": dict(meta or {})}
         blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
         path = self.manifest_path(epoch)
+        if fp is not None and fp.write_should_fail("manifest"):
+            import errno
+            raise OSError(errno.ENOSPC,
+                          "injected disk full (epoch manifest)")
         atomic_write_bytes(path, blob)
         self._retire()
         self._gc_blobs()

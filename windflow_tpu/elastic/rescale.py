@@ -268,6 +268,10 @@ def rescale_operator(graph, handle: ElasticHandle, new_n: int,
                 # so dropping the cells without folding would read as
                 # a permanent duplication on every scale-down
                 graph.auditor.fold_retired(node)
+            if getattr(graph, "tiered_state", None) is not None:
+                # the retired replica's keys migrated with the merge;
+                # its spill segments are dead weight on disk
+                graph.tiered_state.release(node.name)
             if node in handle.pipe.nodes:
                 handle.pipe.nodes.remove(node)
             if node.stats is not None:
@@ -354,6 +358,11 @@ def _grow(graph, handle: ElasticHandle, old_nodes: List[RtNode],
         if fault_plan is not None:
             node.faults = fault_plan.for_node(node.name)
             node.bind_outlet_faults()
+        if getattr(graph, "tiered_state", None) is not None:
+            # tiered keyed state (state/): the grown replica's store
+            # must exist BEFORE the auditor binds its hot-key sketch
+            # and before load_keyed_state repartitions into it
+            graph.tiered_state.enable(node.logic, node.name)
         if graph.auditor is not None:
             # audit plane: delivery books + put faults + sketches on
             # the new replica's own outlets, exactly as at start()

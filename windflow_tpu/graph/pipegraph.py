@@ -100,6 +100,11 @@ class PipeGraph:
         # epoch barriers + manifest commits + exactly-once sink
         # release, built at start() when RuntimeConfig.durability is set
         self.durability = None
+        # tiered keyed state (state/; docs/RESILIENCE.md "Tiered state
+        # & memory pressure"): the TieredStateManager splitting
+        # RuntimeConfig.state_budget_bytes across capable keyed
+        # replicas, built at start() when the budget is set
+        self.tiered_state = None
         # distributed runtime plane (distributed/; docs/DISTRIBUTED.md):
         # the partition plan (node name -> worker id, computed before
         # the fusion pass) and the live transport handle, built at
@@ -389,6 +394,17 @@ class PipeGraph:
                     # poisoning could unblock it (runtime/node.py
                     # SourceLoopLogic.eos_flush)
                     src.cancel_token = self._cancel
+        # tiered keyed state (state/; docs/RESILIENCE.md "Tiered state
+        # & memory pressure"): under RuntimeConfig.state_budget_bytes,
+        # swap capable keyed logics' dict stores for TieredKeyedStores
+        # (hot/warm/cold under the keyed_state_dict contract).  AFTER
+        # flight/dead-letter/fault binding (the stores record
+        # state_pressure/spill_abort and shed into dead_letters),
+        # BEFORE the audit plane (the auditor hands its hot-key
+        # sketches to the stores it finds)
+        if getattr(self.config, "state_budget_bytes", None):
+            from ..state import attach_tiered_state
+            self.tiered_state = attach_tiered_state(self)
         # audit plane (audit/; docs/OBSERVABILITY.md): attach the
         # per-edge delivery books, outlet put-fault state and KEYBY
         # hot-key sketches AFTER fusion/ingest wiring and fault binding
@@ -543,7 +559,8 @@ class PipeGraph:
                 final = self.auditor.final_check()
                 if final:
                     # post-mortem evidence next to the violation events
-                    self.flight.dump(self.config.log_dir, self.name)
+                    self.flight.dump(self.config.log_dir, self.name,
+                                     keep=self.config.snapshot_keep)
         if self._monitor is not None:
             self._monitor.stop()
         if self.config.tracing:
@@ -557,7 +574,8 @@ class PipeGraph:
             self.flight.record(
                 "node_failure", nodes=[name for name, _e in errors],
                 stuck=stuck)
-            self.flight.dump(self.config.log_dir, self.name)
+            self.flight.dump(self.config.log_dir, self.name,
+                             keep=self.config.snapshot_keep)
             err = NodeFailureError.from_pairs(errors, stuck)
             raise err from errors[0][1]
         if self._cancel.cancelled:
@@ -598,6 +616,8 @@ class PipeGraph:
             f"{os.getpid()}_{self.name}{worker_suffix()}_runtime.json")
         with open(path, "w") as f:
             json.dump({"graph": self.name, "channels": rows}, f, indent=1)
+        from ..monitoring.monitor import rotate_snapshots
+        rotate_snapshots(self.config.log_dir, self.config.snapshot_keep)
 
     def _dump_logs(self) -> None:
         """Write per-graph stats JSON + graphviz DOT + a rendered SVG
@@ -625,6 +645,8 @@ class PipeGraph:
             f.write(graph_to_dot(self))
         with open(os.path.join(d, f"{stem}.svg"), "w") as f:
             f.write(graph_to_svg(self))
+        from ..monitoring.monitor import rotate_snapshots
+        rotate_snapshots(d, self.config.snapshot_keep)
 
     def run(self) -> None:
         if not self._started:

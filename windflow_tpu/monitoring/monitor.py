@@ -272,34 +272,56 @@ class MonitoringThread(threading.Thread):
         self.join(timeout=5.0)
 
 
+# the per-run artifact families rotation prunes INDEPENDENTLY (keep
+# the newest N of each): periodic stats snapshots, flight-recorder
+# JSONL dumps, raw runtime-channel stats, and the tracing log dump's
+# json/dot/svg triple.  Families are suffix-disjoint by construction
+# (the log dump's plain ``.json`` carries no ``_stats``/``_runtime``
+# marker), so one family's churn never evicts another's history.
+_ROTATED_FAMILIES = ("_stats.json", "_flight.jsonl", "_runtime.json",
+                     ".dot", ".svg", ".json")
+
+
+def _family_of(name: str) -> Optional[str]:
+    for suffix in _ROTATED_FAMILIES:
+        if name.endswith(suffix):
+            return suffix
+    return None
+
+
 def rotate_snapshots(log_dir: str, keep: int) -> None:
-    """Keep-last-N rotation of the snapshot fallback's
-    ``*_stats.json`` files: delete the oldest (by mtime) beyond
-    ``keep``.  Only the snapshot pattern is touched -- flight dumps,
-    stall reports and per-graph log dumps stay.  ``keep <= 0``
-    disables rotation.  Called once when a fallback loop starts (each
-    run writes one new snapshot file, so per-run pruning bounds the
-    directory)."""
+    """Keep-last-N rotation of ``log_dir``'s per-run artifact
+    families: stats snapshots (``*_stats.json``), flight-recorder
+    dumps (``*_flight.jsonl``), runtime channel stats
+    (``*_runtime.json``) and tracing log dumps (``*.json/.dot/.svg``)
+    -- each family pruned independently, oldest (by mtime) first, so a
+    long supervised soak no longer grows ``log/`` without bound.
+    Stall reports and anything unrecognized stay.  ``keep <= 0``
+    disables rotation.  Called when a snapshot fallback loop starts
+    and after every flight/log dump."""
     if keep is None or keep <= 0:
         return
     try:
-        names = [n for n in os.listdir(log_dir)
-                 if n.endswith("_stats.json")]
-        if len(names) <= keep:
-            return
-        paths = []
-        for n in names:
+        by_family: dict = {}
+        for n in os.listdir(log_dir):
+            fam = _family_of(n)
+            if fam is None:
+                continue
             p = os.path.join(log_dir, n)
             try:
-                paths.append((os.path.getmtime(p), p))
+                by_family.setdefault(fam, []).append(
+                    (os.path.getmtime(p), p))
             except OSError:
                 continue  # raced with another process's rotation
-        paths.sort()
-        for _mt, p in paths[:max(0, len(paths) - keep)]:
-            try:
-                os.remove(p)
-            except OSError:
-                pass
+        for paths in by_family.values():
+            if len(paths) <= keep:
+                continue
+            paths.sort()
+            for _mt, p in paths[:len(paths) - keep]:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
     except OSError:
         pass  # unreadable log dir: rotation is best-effort
 

@@ -34,10 +34,14 @@ from ..telemetry.histogram import LogHistogram
 # 8 = the Durability block gains Delta / Last_commit_bytes (delta
 # snapshot sizing) and the optional Replica_restarts counter
 # (supervised self-healing, durability/supervision.py).
+# 9 = Skew.Census rows may carry tiered keyed-state extras (per-tier
+# "tiers" key/byte splits plus spills / spill_bytes / promotions /
+# demotions / sheds counters -- state/tiers.py census()) and
+# Skew.Hot_keys entries may name each hot key's tier ("tiers").
 # Readers (doctor CLI, dashboard /explain, tests) must tolerate MISSING
 # blocks rather than dispatch on this number: older dumps carry no
 # version field at all, and every block is optional by contract.
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 
 
 @dataclass

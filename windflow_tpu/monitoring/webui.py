@@ -267,10 +267,16 @@ function skewTable(skew) {
   }
   if (census.length) {
     s += `<table><thead><tr><th>keyed state (replica)</th>
-      <th>keys</th><th>est bytes</th></tr></thead><tbody>`;
-    for (const c of census)
+      <th>keys</th><th>est bytes</th><th>tiers</th></tr></thead><tbody>`;
+    for (const c of census) {
+      // tiered stores (state/tiers.py): per-tier key/byte splits
+      const tiers = c.tiers ?
+        Object.entries(c.tiers).filter(([, v]) => num(v[0]) > 0)
+          .map(([t, v]) => `${esc(t)}:${fmt(v[0])}k/${fmt(v[1])}B`)
+          .join(" ") : "–";
       s += `<tr><td>${esc(c.replica)}</td><td>${fmt(c.keys)}</td>
-        <td>${fmt(c.bytes_est)}B</td></tr>`;
+        <td>${fmt(c.bytes_est)}B</td><td>${tiers || "–"}</td></tr>`;
+    }
     s += "</tbody></table>";
   }
   return s;

@@ -126,18 +126,57 @@ class AccumulatorLogic(_ReplicaLogic):
         emit(out)
 
     def state_dict(self):
-        return {"state": self.state}
+        st = self.state
+        if hasattr(st, "materialize"):     # tiered store: inline copy
+            st = st.materialize()
+        return {"state": st}
 
     def load_state(self, st):
-        self.state = st["state"]
+        if hasattr(self.state, "replace_all"):
+            self.state.replace_all(st["state"])
+        else:
+            self.state = st["state"]
+
+    # -- tiered keyed state (state/; docs/RESILIENCE.md "Tiered state
+    # & memory pressure"): under RuntimeConfig.state_budget_bytes the
+    # plain dict is swapped for a TieredKeyedStore -- svc() is
+    # untouched (the store is dict-like and self-maintains its budget
+    # on this thread), every contract below routes through it ---------
+    def enable_tiered_state(self, store):
+        store.replace_all(self.state)
+        self.state = store
+
+    def bind_hot_sketch(self, hot_keys_fn):
+        """Audit plane handoff: pin the sketch's current top keys hot."""
+        if hasattr(self.state, "bind_hot_sketch"):
+            self.state.bind_hot_sketch(hot_keys_fn)
+
+    def state_tier_of(self, key):
+        """Tier name of ``key`` for census/doctor, or None."""
+        if hasattr(self.state, "tier_of"):
+            return self.state.tier_of(key)
+        return "hot" if key in self.state else None
+
+    def keyed_state_pickled(self):
+        """Delta-capture fast path: warm/cold keys serve their stored
+        pickled bytes (durability/delta.KeyedCapture)."""
+        if hasattr(self.state, "keyed_state_pickled"):
+            return self.state.keyed_state_pickled()
+        return None
 
     # -- keyed-state hooks (elastic/rescale.py): the per-key fold store
     # repartitions over a new replica count at runtime rescale --------
     def keyed_state_dict(self):
-        return dict(self.state)
+        st = self.state
+        if hasattr(st, "materialize"):
+            return st.materialize()
+        return dict(st)
 
     def load_keyed_state(self, kv):
-        self.state = dict(kv)
+        if hasattr(self.state, "replace_all"):
+            self.state.replace_all(kv)
+        else:
+            self.state = dict(kv)
 
     # -- audit-plane census (audit/census.py): gauge-grade read from
     # the auditor thread against the LIVE store -- len() is GIL-atomic,
@@ -145,6 +184,8 @@ class AccumulatorLogic(_ReplicaLogic):
     # resize) ---------------------------------------------------------
     def keyed_state_census(self):
         state = self.state
+        if hasattr(state, "census"):       # tiered: per-tier gauges
+            return state.census()
         n = len(state)
         if n == 0:
             return (0, 0)

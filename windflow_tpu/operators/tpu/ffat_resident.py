@@ -419,6 +419,12 @@ class WinSeqFFATResidentLogic(NodeLogic):
                 st.ts_ring = np.asarray(fields[3]).copy()
             self.keys[k] = st
 
+    # -- tiered-state census (state/; audit/auditor._probe_tiers): the
+    # forest keeps every key's window state in device memory -- the top
+    # of the tier ladder, above the host store's hot/warm/cold --------
+    def state_tier_of(self, key):
+        return "device" if key in self.keys else None
+
     # -- keyed-state hooks (elastic/rescale.py): the resident forest IS
     # the per-key window state, so repartitioning pulls each key's LIVE
     # leaf span off the device and re-scatters it on the owner replica;

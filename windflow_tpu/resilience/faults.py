@@ -240,6 +240,13 @@ class FaultPlan:
         # epochs whose manifest commit is torn (read by the
         # EpochCoordinator; graph-global, no node binding)
         self.torn_commit_epochs: set = set()
+        # injected full-filesystem windows per durable-write kind
+        # ("manifest" | "blob" | "spill"): kind -> list of (first,
+        # last) 1-based write indices that raise ENOSPC.  Graph-global
+        # with its own clock per kind, like torn_commit_epochs.
+        self._fail_writes: dict = {}
+        self._write_clock: dict = {}
+        self._write_lock = threading.Lock()
         self._native_armed = False
 
     # -- declaration (chainable) --------------------------------------
@@ -352,6 +359,41 @@ class FaultPlan:
     def kill_tuple_for(self, worker: int):
         """The kill threshold of ``worker``'s transport clock, or None."""
         return self._kills.get(int(worker))
+
+    def fail_write(self, path_kind: str, at_write: int = 1,
+                   count: int = 1) -> "FaultPlan":
+        """The filesystem "fills up" for durable writes of
+        ``path_kind`` -- ``"manifest"`` (epoch manifests),
+        ``"blob"`` (delta blobs) or ``"spill"`` (cold-tier segments):
+        writes ``at_write .. at_write+count-1`` (1-based, counted per
+        kind across the graph) raise ``OSError(ENOSPC)`` at the write
+        layer.  The durability/state planes must degrade -- abort the
+        epoch / keep the batch warm with a flight event -- never die.
+        A large ``count`` models a disk that stays full."""
+        if path_kind not in ("manifest", "blob", "spill"):
+            raise ValueError(
+                "path_kind must be 'manifest', 'blob' or 'spill', "
+                f"not {path_kind!r}")
+        if at_write < 1:
+            raise ValueError("at_write is 1-based")
+        if count < 1:
+            raise ValueError("count must be >= 1")
+        self._fail_writes.setdefault(path_kind, []).append(
+            (at_write, at_write + count - 1))
+        return self
+
+    def write_should_fail(self, path_kind: str) -> bool:
+        """Called by the write layer (EpochStore manifests, BlobStore
+        delta blobs, SpillStore segments) before each durable write of
+        ``path_kind``; advances that kind's clock and reports whether
+        this write lands in an injected full-filesystem window."""
+        rules = self._fail_writes.get(path_kind)
+        if not rules:
+            return False
+        with self._write_lock:
+            self._write_clock[path_kind] = n = \
+                self._write_clock.get(path_kind, 0) + 1
+        return any(first <= n <= last for first, last in rules)
 
     def fail_native_build(self) -> "FaultPlan":
         """Force the native toolchain probe to fail from now until

@@ -220,6 +220,26 @@ def render_openmetrics(apps: dict) -> str:
                 f"windflow_keyed_state_keys"
                 f"{_labels(**lab, replica=row.get('replica', ''))} "
                 f"{int(row.get('keys', 0) or 0)}")
+    family("windflow_keyed_state_bytes", "gauge",
+           "keyed-state bytes by storage tier (tiered store census)")
+    for rep, lab in per_graph():
+        skew = rep.get("Skew") or {}
+        for row in skew.get("Census", []):
+            for tier, kb in (row.get("tiers") or {}).items():
+                out.append(
+                    f"windflow_keyed_state_bytes"
+                    f"{_labels(**lab, replica=row.get('replica', ''), tier=tier)} "
+                    f"{int(kb[1] if isinstance(kb, (list, tuple)) else kb)}")
+    family("windflow_state_spills", "counter",
+           "keys spilled to disk by tiered keyed-state stores")
+    for rep, lab in per_graph():
+        skew = rep.get("Skew") or {}
+        for row in skew.get("Census", []):
+            if "spills" in row:
+                out.append(
+                    f"windflow_state_spills_total"
+                    f"{_labels(**lab, replica=row.get('replica', ''))} "
+                    f"{int(row.get('spills', 0) or 0)}")
     family("windflow_hot_key_share", "gauge",
            "estimated share of the hottest key on a KEYBY edge")
     for rep, lab in per_graph():

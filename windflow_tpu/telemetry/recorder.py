@@ -55,10 +55,14 @@ class FlightRecorder:
     def __len__(self) -> int:
         return len(self._ring)
 
-    def dump(self, log_dir: str, graph_name: str) -> Optional[str]:
+    def dump(self, log_dir: str, graph_name: str,
+             keep: Optional[int] = None) -> Optional[str]:
         """Write the ring as JSONL under ``log_dir``; returns the path
         (best-effort: an unwritable log dir must not mask the failure
-        being post-mortemed)."""
+        being post-mortemed).  ``keep`` > 0 additionally rotates the
+        log dir's per-run artifact families down to the newest N
+        (monitoring.rotate_snapshots), so repeated supervised dumps do
+        not grow ``log/`` without bound."""
         if not self.enabled:
             return None
         try:
@@ -74,6 +78,9 @@ class FlightRecorder:
                 for ev in self.snapshot():
                     f.write(json.dumps(ev, default=str) + "\n")
             self.dumped_path = path
+            if keep:
+                from ..monitoring.monitor import rotate_snapshots
+                rotate_snapshots(log_dir, keep)
             return path
         except OSError:
             return None
