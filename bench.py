@@ -1775,6 +1775,184 @@ def run_replan_shift(n_events=1_200_000, source_batch=1500,
                 os.environ[k] = v
 
 
+class _WmClock:
+    """Wall-clock stamps of a watermarked source's emission boundaries:
+    ``reached(x)`` is the first wall time the source's watermark was
+    known to be >= x (the seal stamps +inf, so every fired window has a
+    birth)."""
+
+    def __init__(self):
+        self.w = []  # nondecreasing watermark values
+        self.t = []  # perf_counter at the emission boundary
+
+    def note(self, wm):
+        self.w.append(wm)
+        self.t.append(time.perf_counter())
+
+    def reached(self, x):
+        import bisect
+        i = bisect.bisect_left(self.w, x)
+        return self.t[i] if i < len(self.t) else None
+
+
+def _stamped_record_source(keys, tss, values, clock, every=32):
+    """The models/nexmark.py record source with the watermark cadence
+    mirrored into ``clock``: one stamp per emitted watermark, one +inf
+    stamp at the seal."""
+    from windflow_tpu.core.tuples import BasicRecord
+    from windflow_tpu.eventtime import watermarked
+
+    n = len(keys)
+    state = {"i": 0, "hi": float("-inf")}
+
+    def body(shipper):
+        i = state["i"]
+        if i >= n:
+            clock.note(float("inf"))
+            return False
+        shipper.push(BasicRecord(int(keys[i]), i, int(tss[i]), values[i]))
+        if float(tss[i]) > state["hi"]:
+            state["hi"] = float(tss[i])
+        state["i"] = i + 1
+        if state["i"] % every == 0:
+            clock.note(state["hi"])
+        return True
+
+    return watermarked(body, every=every)
+
+
+def run_nexmark_joins(n_bids):
+    """Config #18: the event-time relational lane (docs/EVENTTIME.md;
+    models/nexmark.py).  Q4 = auctions |><| bids per tumbling window ->
+    closing-price average per category; Q8 = persons |><| auctions
+    new-user monitor.  Both runs are ORACLE-ASSERTED against the numpy
+    twins (exact multiset equality for Q8, per-window float agreement
+    for Q4).  The Q8 run measures TRUE watermark-to-result latency:
+    birth = the later of the two sources' wall stamps at which the
+    window became fire-eligible (min-merged watermark >= window end),
+    emission = sink arrival.  A third, planted-late lane asserts the
+    loud-lateness contract: every planted straggler lands in dead
+    letters (counted in the report), none silently vanishes."""
+    import windflow_tpu as wf
+    from windflow_tpu.core.tuples import BasicRecord
+    from windflow_tpu.eventtime import EventTimeWindow, watermarked
+    from windflow_tpu.models.nexmark import (
+        build_q4_avg_price, build_q8_new_users, q4_oracle, q8_oracle,
+        synth_auctions, synth_bids, synth_persons)
+    from windflow_tpu.operators.basic_ops import Sink
+
+    n_side = max(256, n_bids // 8)
+    win = 256
+    persons = synth_persons(n_side, n_cities=16)
+    auctions = synth_auctions(n_side, n_sellers=max(8, n_side // 2))
+    bids = synth_bids(n_bids, n_auctions=n_side)
+
+    # -- Q4: closing-price average per category ----------------------
+    lock = threading.Lock()
+    q4 = {}
+
+    def q4_sink(r):
+        if r is not None:
+            with lock:
+                q4[(r.key, r.ts)] = r.value
+
+    g4 = wf.PipeGraph("bench18_q4", wf.Mode.DEFAULT)
+    build_q4_avg_price(g4, auctions, bids, win, q4_sink)
+    t0 = time.perf_counter()
+    g4.run()
+    dt4 = time.perf_counter() - t0
+    want4 = q4_oracle(auctions, bids, win)
+    assert set(q4) == set(want4) and all(
+        abs(q4[k] - want4[k]) < 1e-9 for k in want4), \
+        "Q4 diverged from the numpy oracle"
+    assert g4.dead_letters.count() == 0, "Q4 quarantined on-time tuples"
+
+    # -- Q8: new-user monitor, watermark-to-result latency -----------
+    clock_p, clock_a = _WmClock(), _WmClock()
+    clocks = iter((clock_p, clock_a))
+    q8 = []
+
+    def q8_sink(r):
+        if r is not None:
+            now = time.perf_counter()
+            with lock:
+                q8.append((r.key, r.ts, r.value, now))
+
+    g8 = wf.PipeGraph("bench18_q8", wf.Mode.DEFAULT)
+    build_q8_new_users(
+        g8, persons, auctions, win, q8_sink,
+        source_of=lambda k, t, v: _stamped_record_source(
+            k, t, v, next(clocks)))
+    t0 = time.perf_counter()
+    g8.run()
+    dt8 = time.perf_counter() - t0
+    got8 = sorted((int(k), int(ts), int(v[0]), int(v[1]))
+                  for k, ts, v, _ in q8)
+    assert got8 == q8_oracle(persons, auctions, win), \
+        "Q8 diverged from the numpy oracle"
+    assert g8.dead_letters.count() == 0, "Q8 quarantined on-time tuples"
+    lats = []
+    for _k, ts, _v, now in q8:
+        birth = max(clock_p.reached(ts + win), clock_a.reached(ts + win))
+        lats.append(max(0.0, now - birth))
+
+    # -- planted-late lane: the loud-lateness contract ---------------
+    m, planted = 20_000, 7
+    ts = list(range(m))
+    stragglers = ts[m // 2:m // 2 + planted]
+    on_time = ts[:m // 2] + ts[m // 2 + planted:]
+    order = on_time + stragglers  # stragglers arrive a half-stream late
+    state = {"i": 0}
+
+    def late_body(shipper):
+        i = state["i"]
+        if i >= len(order):
+            return False
+        shipper.push(BasicRecord(0, i, float(order[i]), 1.0))
+        state["i"] = i + 1
+        return True
+
+    sums = {}
+
+    def late_sink(r):
+        if r is not None:
+            with lock:
+                sums[r.ts] = r.value
+
+    gl = wf.PipeGraph("bench18_late", wf.Mode.DEFAULT)
+    gl.add_source(wf.SourceBuilder(
+        watermarked(late_body, every=16)).build()) \
+        .add(EventTimeWindow(sum, 32.0, name="late_win")) \
+        .add_sink(Sink(late_sink, name="late_sink"))
+    gl.run()
+    quarantined = gl.dead_letters.count()
+    assert quarantined == planted, \
+        f"planted {planted} stragglers, quarantined {quarantined}"
+    expect = {}
+    for t in on_time:
+        expect[float(t // 32 * 32)] = expect.get(float(t // 32 * 32), 0) + 1
+    assert sums == expect, "late lane fired wrong window sums"
+    # the loud-accounting surface: every quarantine also announces a
+    # late_data flight event carrying the drop count
+    late_stat = sum(e["n"] for e in gl.flight.snapshot()
+                    if e["kind"] == "late_data")
+
+    fed = n_bids + 3 * n_side  # q4: auctions+bids; q8: persons+auctions
+    p50 = round(float(np.percentile(lats, 50)) * 1e3, 2) if lats else None
+    p99 = round(float(np.percentile(lats, 99)) * 1e3, 2) if lats else None
+    return {
+        "rate": round(fed / (dt4 + dt8), 1),
+        "q4_windows": len(q4),
+        "q8_pairs": len(got8),
+        "p50_ms": p50,
+        "p99_ms": p99,
+        "lats": lats,
+        "late": {"planted": planted, "quarantined": quarantined,
+                 "flight_events_n": late_stat,
+                 "q4_dead_letters": 0, "q8_dead_letters": 0},
+    }
+
+
 def run_reference_arch_baseline(n_events):
     """The honest baseline: identical workload through the native C++
     record-at-a-time engine in the reference's architecture (one thread
@@ -2131,6 +2309,14 @@ def main():
     # keyed state between the delta and full lanes; recovery time
     # (chain resolution included) reported for both
     configs["16_delta_snapshot_overhead"] = run_delta_snapshot_overhead()
+    # event-time relational lane (docs/EVENTTIME.md): Q4 + Q8 joins,
+    # oracle-asserted, with watermark-to-result p50/p99 and the
+    # planted-late quarantine count.  Record plane (one python tuple
+    # per step), so the size is modest by design -- the rate documents
+    # the per-record event-time cost, not a batch-plane headline.
+    r18 = run_nexmark_joins(200_000)
+    r18.pop("lats", None)
+    configs["18_nexmark_joins"] = r18
     for name, c in configs.items():
         n_out = c.get("windows", c.get("records", 0))
         print(f"[bench] {name}: {c['rate']:,.0f} tuples/s "

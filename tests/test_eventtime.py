@@ -1,0 +1,886 @@
+"""Event-time relational plane tests (eventtime/; docs/EVENTTIME.md):
+watermark-triggered tumbling/sliding windows bitwise-equal to numpy
+oracles under arrival shuffle, gap-based session windows merging on
+overlap, two-input interval/window joins with watermark eviction, loud
+allowed-lateness quarantine (dead letters + late_data flight events +
+gauges), the declarative frontend, the NexMark Q3/Q4/Q6/Q8 relational
+queries against their oracles (Q1/Q2 numpy here; Q5/Q7 device queries
+are oracle-tested in test_models_configs.py), and the robustness
+chaos: session windows crash-restarted under exactly-once epochs match
+the uninterrupted oracle, and join keyed state survives mid-stream
+elastic rescale with zero lost or duplicated pairs."""
+import collections
+import json
+import math
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.core import BasicRecord, DurabilityConfig, Mode
+from windflow_tpu.core.basic import ElasticSpec, OrderingMode
+from windflow_tpu.durability import run_with_epochs
+from windflow_tpu.eventtime import (LEFT, RIGHT, IntervalJoin,
+                                    IntervalJoinLogic, SessionWindow,
+                                    WatermarkedSource, Watermark,
+                                    WindowJoin, EventTimeWindow,
+                                    tag_side, watermarked)
+from windflow_tpu.eventtime.sessions import SessionWindowLogic
+from windflow_tpu.operators.basic_ops import Sink
+from windflow_tpu.resilience import DeadLetterStore, FaultPlan
+from windflow_tpu.runtime.node import SourceLoopLogic
+from windflow_tpu.runtime.ordering import KSlackLogic, LateTupleDropped
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _sum(vals):
+    tot = 0.0
+    for v in vals:
+        tot += v
+    return tot
+
+
+def _shipper_source(events, every=16, skew=0.0):
+    """Watermarked shipper body pushing one (key, tid, ts, value)
+    record per step."""
+    state = {"i": 0}
+
+    def body(shipper):
+        i = state["i"]
+        if i >= len(events):
+            return False
+        k, tid, ts, v = events[i]
+        shipper.push(BasicRecord(k, tid, ts, v))
+        state["i"] = i + 1
+        return True
+
+    return watermarked(body, every=every, skew=skew)
+
+
+def _block_shuffle(events, block=32, seed=0):
+    """Bounded-disorder permutation: shuffle inside consecutive blocks
+    so no tuple trails the running maximum by more than `block` ticks
+    (times the ts stride)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(0, len(events), block):
+        chunk = list(events[i:i + block])
+        rng.shuffle(chunk)
+        out.extend(chunk)
+    return out
+
+
+def _window_oracle(events, agg, size, slide=None):
+    """{(key, win_start): agg(values sorted by (ts, id))}."""
+    slide = slide or size
+    rows = collections.defaultdict(list)
+    for k, tid, ts, v in events:
+        n_hi = math.floor(ts / slide)
+        n_lo = math.floor((ts - size) / slide) + 1
+        for n in range(n_lo, n_hi + 1):
+            rows[(k, n * slide)].append((ts, tid, v))
+    return {kw: agg([r[2] for r in sorted(rs)])
+            for kw, rs in rows.items()}
+
+
+def _collect_windows(sink_out):
+    return {(r[0], r[2]): r[3] for r in sink_out}
+
+
+class _Acc:
+    """Thread-safe record collector sink."""
+
+    def __init__(self):
+        self.items = []
+        self._lock = threading.Lock()
+
+    def __call__(self, rec):
+        if rec is not None:
+            with self._lock:
+                self.items.append(
+                    (rec.key, rec.id, rec.ts, rec.value))
+
+
+# ---------------------------------------------------------------------------
+# watermark-triggered windows: oracle equality under arrival shuffle
+# ---------------------------------------------------------------------------
+
+def test_tumbling_window_bitwise_oracle_under_shuffle():
+    """The determinism contract: two differently-shuffled arrival
+    orders of the same event set produce BITWISE identical window
+    results, equal to the numpy-side oracle."""
+    events = [(i % 4, i, float(i), float((i * 7) % 13) + 0.25)
+              for i in range(400)]
+    oracle = _window_oracle(events, _sum, size=20.0)
+    results = []
+    for seed in (1, 2):
+        shuffled = _block_shuffle(events, block=32, seed=seed)
+        got = _Acc()
+        g = wf.PipeGraph(f"ev_win_{seed}", Mode.DEFAULT)
+        g.add_source(wf.SourceBuilder(
+            _shipper_source(shuffled, every=16, skew=64.0)).build()) \
+            .add(EventTimeWindow(_sum, size=20.0, parallelism=2)) \
+            .add_sink(Sink(got))
+        g.run()
+        results.append(_collect_windows(got.items))
+    assert results[0] == oracle
+    assert results[0] == results[1]  # bitwise across shuffles
+
+
+def test_sliding_windows_fire_with_ids_and_ts():
+    """size > slide: each tuple lands in size/slide windows; fired
+    records carry ts = win_start and id = win_start // slide."""
+    events = [(0, i, float(i), 1.0) for i in range(100)]
+    oracle = _window_oracle(events, _sum, size=30.0, slide=10.0)
+    got = _Acc()
+    g = wf.PipeGraph("ev_slide", Mode.DEFAULT)
+    g.add_source(wf.SourceBuilder(
+        _shipper_source(events, every=8)).build()) \
+        .add(EventTimeWindow(_sum, size=30.0, slide=10.0)) \
+        .add_sink(Sink(got))
+    g.run()
+    assert _collect_windows(got.items) == oracle
+    for key, wid, ts, _v in got.items:
+        assert key == 0 and wid == int(ts // 10.0)
+
+
+def test_late_tuple_quarantined_loudly(tmp_path):
+    """A tuple behind the allowed-lateness horizon: excluded from
+    results, quarantined in the dead-letter store with a
+    LateTupleDropped reason, announced as a late_data flight event,
+    and counted in the stats JSON Late_tuples gauge."""
+    # ordered stream advances the watermark far past window [0, 10)
+    events = [(0, i, float(i), 1.0) for i in range(100)]
+    events.append((1, 100, 3.0, 99.0))   # 3 << wm by now: late
+    on_time = events[:-1]
+    got = _Acc()
+    cfg = wf.RuntimeConfig(tracing=True, log_dir=str(tmp_path))
+    g = wf.PipeGraph("ev_late", Mode.DEFAULT, config=cfg)
+    g.add_source(wf.SourceBuilder(
+        _shipper_source(events, every=8, skew=0.0)).build()) \
+        .add(EventTimeWindow(_sum, size=10.0)) \
+        .add_sink(Sink(got))
+    g.run()
+    assert _collect_windows(got.items) == \
+        _window_oracle(on_time, _sum, size=10.0)
+    assert g.dead_letters.count() == 1
+    entry = g.dead_letters.entries[0]
+    assert isinstance(entry.error, LateTupleDropped)
+    assert entry.item == (1, 100, 3.0, 99.0)
+    assert "event_window" in entry.node
+    evs = [e for e in g.flight.snapshot() if e["kind"] == "late_data"]
+    assert evs and evs[0]["n"] == 1 and evs[0]["ts"] == 3.0
+    rep = json.loads(g.stats.to_json())
+    assert rep["Schema_version"] >= 10
+    win_op = next(o for o in rep["Operators"]
+                  if "event_window" in o["Operator_name"])
+    assert sum(r.get("Late_tuples", 0)
+               for r in win_op["Replicas"]) == 1
+    assert rep["Conservation"]["Dead_letters"] == 1
+
+
+def test_allowed_lateness_keeps_stragglers():
+    """lateness=K holds windows open K ticks past the watermark: the
+    same straggler that test_late_tuple drops is aggregated here."""
+    events = [(0, i, float(i), 1.0) for i in range(40)]
+    straggler = (0, 40, float(30), 5.0)   # arrives after wm ~ 39
+    all_events = events + [straggler]
+    got = _Acc()
+    g = wf.PipeGraph("ev_grace", Mode.DEFAULT)
+    g.add_source(wf.SourceBuilder(
+        _shipper_source(all_events, every=4, skew=0.0)).build()) \
+        .add(EventTimeWindow(_sum, size=10.0, lateness=20.0)) \
+        .add_sink(Sink(got))
+    g.run()
+    assert g.dead_letters.count() == 0
+    assert _collect_windows(got.items) == \
+        _window_oracle(all_events, _sum, size=10.0)
+
+
+# ---------------------------------------------------------------------------
+# session windows
+# ---------------------------------------------------------------------------
+
+def test_session_windows_merge_on_bridge_and_close():
+    """Two live sessions bridged by one tuple merge into one; fired
+    record carries (start, tuple count, agg of sorted values)."""
+    events = [
+        (0, 0, 0.0, 1.0), (0, 1, 1.0, 2.0), (0, 2, 2.0, 3.0),
+        (0, 3, 10.0, 4.0), (0, 4, 11.0, 5.0),
+        (0, 5, 6.0, 6.0),          # bridges [0,2] and [10,11] (gap 5)
+        (1, 6, 0.0, 7.0),          # second key: independent session
+        (0, 7, 30.0, 8.0),         # new session (30 - 11 > gap)
+    ]
+    got = _Acc()
+    g = wf.PipeGraph("ev_sess", Mode.DEFAULT)
+    g.add_source(wf.SourceBuilder(
+        _shipper_source(events, every=100)).build()) \
+        .add(SessionWindow(_sum, gap=5.0)) \
+        .add_sink(Sink(got))
+    g.run()
+    # (key, n_rows, start, agg)
+    assert sorted(got.items) == sorted([
+        (0, 6, 0.0, 1.0 + 2.0 + 3.0 + 6.0 + 4.0 + 5.0),
+        (0, 1, 30.0, 8.0),
+        (1, 1, 0.0, 7.0),
+    ])
+
+
+def test_session_closes_at_watermark_not_before():
+    """A session fires exactly when wm passes last + gap + lateness:
+    with an ordered stream the early sessions close MID-RUN (before
+    EOS), observed via the fired-record count racing the source."""
+    # 20 bursts of 4 tuples per key, bursts 20 ticks apart (gap 5)
+    K, B, L = 3, 20, 4
+    events = []
+    for b in range(B):
+        for j in range(L):
+            for k in range(K):
+                events.append((k, b * L + j, float(b * 20 + j),
+                               float(k + 1)))
+    events.sort(key=lambda e: e[2])
+    got = _Acc()
+    g = wf.PipeGraph("ev_sess_wm", Mode.DEFAULT)
+    g.add_source(wf.SourceBuilder(
+        _shipper_source(events, every=8, skew=0.0)).build()) \
+        .add(SessionWindow(_sum, gap=5.0, parallelism=2)) \
+        .add_sink(Sink(got))
+    g.run()
+    assert len(got.items) == K * B
+    for k, n, start, v in got.items:
+        assert n == L and start % 20 == 0.0
+        assert v == (k + 1) * L
+
+
+def test_session_late_tuple_quarantined(tmp_path):
+    """A tuple that can no longer open or join any session (wm already
+    past ts + gap + lateness) is dead-lettered, and the open-session
+    gauge lands in the stats JSON."""
+    events = [(0, i, float(i * 3), 1.0) for i in range(50)]
+    events.append((1, 50, 0.0, 9.0))   # wm ~ 147: hopeless
+    cfg = wf.RuntimeConfig(tracing=True, log_dir=str(tmp_path))
+    got = _Acc()
+    g = wf.PipeGraph("ev_sess_late", Mode.DEFAULT, config=cfg)
+    g.add_source(wf.SourceBuilder(
+        _shipper_source(events, every=8, skew=0.0)).build()) \
+        .add(SessionWindow(_sum, gap=4.0)) \
+        .add_sink(Sink(got))
+    g.run()
+    assert g.dead_letters.count() == 1
+    assert g.dead_letters.entries[0].item == (1, 50, 0.0, 9.0)
+    assert "session_window" in g.dead_letters.entries[0].node
+    rep = json.loads(g.stats.to_json())
+    sess_op = next(o for o in rep["Operators"]
+                   if "session_window" in o["Operator_name"])
+    assert sum(r.get("Late_tuples", 0)
+               for r in sess_op["Replicas"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# joins: oracle equality, eviction, late arrivals
+# ---------------------------------------------------------------------------
+
+def _join_graph(g, left, right, op, sink, key_of=lambda r: r.key):
+    p1 = g.add_source(wf.SourceBuilder(
+        _shipper_source(left, every=8)).build())
+    p1.chain(tag_side(LEFT, key_of=key_of))
+    p2 = g.add_source(wf.SourceBuilder(
+        _shipper_source(right, every=8)).build())
+    p2.chain(tag_side(RIGHT, key_of=key_of))
+    p1.merge(p2).add(op).add_sink(Sink(sink))
+
+
+def test_interval_join_matches_nested_loop_oracle():
+    lo, hi = -4.0, 4.0
+    left = [(i % 3, i, float(i), 100.0 + i) for i in range(60)]
+    right = [(i % 3, i, float(i) + 0.5, 200.0 + i) for i in range(60)]
+    oracle = sorted(
+        (k, lv, rv)
+        for k, _t, lts, lv in left
+        for k2, _t2, rts, rv in right
+        if k2 == k and lo <= rts - lts <= hi)
+    got = _Acc()
+    g = wf.PipeGraph("ev_ijoin", Mode.DEFAULT)
+    _join_graph(g, left, right,
+                IntervalJoin(lo, hi, parallelism=2), got)
+    g.run()
+    assert sorted((k, v[0], v[1]) for k, _i, _t, v in got.items) \
+        == oracle
+
+
+def test_interval_join_watermark_eviction_and_late_drop():
+    """Unit-level: the watermark evicts buffered rows past their match
+    horizon and quarantines an arrival whose horizon already passed."""
+    logic = IntervalJoinLogic(lower=-2.0, upper=2.0)
+    logic.dead_letters = DeadLetterStore()
+    out = []
+
+    def mk(side, key, tid, ts, v):
+        from windflow_tpu.eventtime import Sided
+        return Sided(side, key, tid, ts, v)
+
+    logic.svc(mk(LEFT, 7, 0, 10.0, "l0"), 0, out.append)
+    logic.svc(mk(RIGHT, 7, 1, 11.0, "r0"), 0, out.append)
+    assert [(r.key, r.value) for r in out] == [(7, ("l0", "r0"))]
+    assert 7 in logic.state and logic.state[7]["L"]
+    # wm = 20: left row evictable once 10 + upper(2) < 20
+    logic.on_watermark(Watermark(20.0), out.append)
+    assert logic.state == {}
+    # an arrival already behind its own horizon quarantines
+    logic.svc(mk(LEFT, 7, 2, 10.0, "late"), 0, out.append)
+    assert logic.dead_letters.count() == 1
+    assert isinstance(logic.dead_letters.entries[0].error,
+                      LateTupleDropped)
+    # infinite bounds: nothing ever evicts (full-history join)
+    full = IntervalJoinLogic(float("-inf"), float("inf"))
+    full.svc(mk(LEFT, 1, 0, 0.0, "l"), 0, out.append)
+    full.on_watermark(Watermark(1e12), out.append)
+    assert 1 in full.state
+
+
+def test_window_join_cross_product_oracle():
+    size = 16.0
+    left = [(i % 4, i, float(i), ("L", i)) for i in range(120)]
+    right = [(i % 4, i, float(i), ("R", i)) for i in range(120)]
+    oracle = sorted(
+        (k, n * 16.0, lv, rv)
+        for k, _t, lts, lv in left
+        for k2, _t2, rts, rv in right
+        for n in [int(lts // size)]
+        if k2 == k and int(rts // size) == n)
+    got = _Acc()
+    g = wf.PipeGraph("ev_wjoin", Mode.DEFAULT)
+    _join_graph(g, left, right, WindowJoin(size, parallelism=2), got)
+    g.run()
+    assert sorted((k, ts, v[0], v[1]) for k, _i, ts, v in got.items) \
+        == oracle
+
+
+def test_join_state_gauge_exported(tmp_path):
+    """Join_state_keys rides the replica stats records under tracing."""
+    left = [(k, k, 0.0, float(k)) for k in range(6)]
+    right = [(6 + k, k, 0.0, float(k)) for k in range(3)]  # no match
+    cfg = wf.RuntimeConfig(tracing=True, log_dir=str(tmp_path))
+    got = _Acc()
+    g = wf.PipeGraph("ev_join_gauge", Mode.DEFAULT, config=cfg)
+    _join_graph(g, left, right,
+                IntervalJoin(float("-inf"), float("inf")), got)
+    g.run()
+    rep = json.loads(g.stats.to_json())
+    join_op = next(o for o in rep["Operators"]
+                   if "interval_join" in o["Operator_name"])
+    # infinite bounds: all 9 keys still buffered at end of stream
+    assert sum(r.get("Join_state_keys", 0)
+               for r in join_op["Replicas"]) == 9
+
+
+# ---------------------------------------------------------------------------
+# declarative frontend
+# ---------------------------------------------------------------------------
+
+def test_stream_query_where_select_window():
+    events = [(i % 2, i, float(i), float(i % 5)) for i in range(200)]
+    kept = [(k, t, ts, v * 10.0) for k, t, ts, v in events if v > 1.0]
+    oracle = _window_oracle(kept, _sum, size=25.0)
+    got = _Acc()
+    g = wf.PipeGraph("ev_query", Mode.DEFAULT)
+
+    def scale(t):
+        t.value *= 10.0
+
+    q = wf.query(g.add_source(wf.SourceBuilder(
+        _shipper_source(events, every=16, skew=8.0)).build()))
+    q.where(lambda t: t.value > 1.0).select(scale) \
+        .window(_sum, size=25.0).sink(got)
+    g.run()
+    assert _collect_windows(got.items) == oracle
+
+
+def test_stream_query_join_and_session():
+    left = [(i % 2, i, float(i), 1.0 + i) for i in range(40)]
+    right = [(i % 2, i, float(i), 100.0 + i) for i in range(40)]
+    oracle = sorted(
+        (k, lv, rv)
+        for k, _t, lts, lv in left
+        for k2, _t2, rts, rv in right
+        if k2 == k and -1.0 <= rts - lts <= 1.0)
+    got = _Acc()
+    g = wf.PipeGraph("ev_query_join", Mode.DEFAULT)
+    ql = wf.query(g.add_source(wf.SourceBuilder(
+        _shipper_source(left, every=8)).build()))
+    qr = wf.query(g.add_source(wf.SourceBuilder(
+        _shipper_source(right, every=8)).build()))
+    ql.join(qr, lower=-1.0, upper=1.0).sink(got)
+    g.run()
+    assert sorted((k, v[0], v[1]) for k, _i, _t, v in got.items) \
+        == oracle
+    with pytest.raises(ValueError, match="exactly one"):
+        ql.join(qr)   # neither window nor interval bounds
+    # session combinator end to end
+    sess_events = [(0, i, float(i), 1.0) for i in range(5)] \
+        + [(0, 9, 50.0, 2.0)]
+    got2 = _Acc()
+    g2 = wf.PipeGraph("ev_query_sess", Mode.DEFAULT)
+    wf.query(g2.add_source(wf.SourceBuilder(
+        _shipper_source(sess_events, every=100)).build())) \
+        .session(_sum, gap=3.0).sink(got2)
+    g2.run()
+    assert sorted(got2.items) == [(0, 1, 50.0, 2.0), (0, 5, 0.0, 5.0)]
+
+
+# ---------------------------------------------------------------------------
+# watermark generation + observation API
+# ---------------------------------------------------------------------------
+
+def test_watermarked_source_promise_and_checkpoint():
+    src = _shipper_source([(0, i, float(i), 1.0) for i in range(10)],
+                          every=4, skew=1.5)
+    assert wf.watermark_of(src) == float("-inf")
+
+    class _Ship:
+        def __init__(self):
+            self.items = []
+
+        def push(self, item):
+            self.items.append(item)
+
+    ship = _Ship()
+    for _ in range(4):
+        assert src(ship)
+    wms = [x for x in ship.items if isinstance(x, Watermark)]
+    assert wms and wms[-1].ts == 3.0 - 1.5
+    assert wf.watermark_of(src) == 1.5
+    # checkpoint roundtrip restores the clock AND the body offset
+    st = src.state_dict()
+    assert st["inner"] is None   # plain closure body: no inner state
+    clone = WatermarkedSource(lambda s: False, every=4, skew=1.5)
+    clone.load_state(st)
+    assert clone.current_watermark == 1.5
+    while src(ship):
+        pass
+    assert wf.watermark_of(src) == float("inf")
+    assert isinstance(ship.items[-1], Watermark)
+    assert ship.items[-1].ts == float("inf")
+
+
+def test_watermark_of_node_and_frontier_fallback():
+    events = [(0, i, float(i), 1.0) for i in range(64)]
+    got = _Acc()
+    g = wf.PipeGraph("ev_wm_of", Mode.DEFAULT)
+    g.add_source(wf.SourceBuilder(
+        _shipper_source(events, every=8)).build()) \
+        .add(EventTimeWindow(_sum, size=16.0, parallelism=2)) \
+        .add_sink(Sink(got))
+    g.run()
+    # every consumer node forwarded the sealing Watermark(inf)
+    consumers = [n for n in g._all_nodes() if n.channel is not None]
+    assert consumers
+    assert all(wf.watermark_of(n) == float("inf") for n in consumers)
+    # a non-event-time source degrades to the transport frontier
+    sources = [n for n in g._all_nodes() if n.channel is None]
+    assert all(wf.watermark_of(n) > 0 for n in sources)
+
+
+# ---------------------------------------------------------------------------
+# K-slack drop accounting (runtime/ordering.py; satellite of this
+# plane: PROBABILISTIC-mode event-time loss is equally loud)
+# ---------------------------------------------------------------------------
+
+def test_kslack_drops_quarantined_with_flight_event():
+    from windflow_tpu.core.tuples import TupleBatch
+    from windflow_tpu.telemetry import FlightRecorder
+
+    logic = KSlackLogic(OrderingMode.TS)
+    logic.dead_letters = DeadLetterStore()
+    logic.flight = FlightRecorder(16)
+    logic.last_timestamp = 50
+    out = []
+    logic._emit_in_order([BasicRecord(3, 1, 10, 1.0)], out.append)
+    assert logic.dropped == 1 and not out
+    assert logic.dead_letters.count() == 1
+    entry = logic.dead_letters.entries[0]
+    assert isinstance(entry.error, LateTupleDropped)
+    assert entry.node == "kslack"
+    evs = [e for e in logic.flight.snapshot()
+           if e["kind"] == "late_data"]
+    assert evs and evs[0]["n"] == 1 and evs[0]["watermark"] == 50
+    # columnar lane: one dead-letter entry per dropped sub-batch,
+    # counters advance by the tuple count
+    tb = TupleBatch({"key": np.zeros(4, np.int64),
+                     "id": np.arange(4, dtype=np.int64),
+                     "ts": np.array([10, 20, 60, 70], np.int64),
+                     "value": np.ones(4)})
+    logic._emit_batch_in_order(tb, out.append)
+    assert logic.dropped == 3
+    assert logic.dead_letters.count() == 3
+    assert len(logic.dead_letters.entries) == 2   # record + batch sample
+    evs = [e for e in logic.flight.snapshot()
+           if e["kind"] == "late_data"]
+    assert sum(e["n"] for e in evs) == 3
+
+
+# ---------------------------------------------------------------------------
+# NexMark: Q1/Q2 numpy, Q3/Q4/Q6/Q8 relational graphs vs oracles
+# (Q5/Q7 device queries covered in test_models_configs.py /
+# test_fusion.py -- together the suite spans Q1-Q8)
+# ---------------------------------------------------------------------------
+
+class TestNexmarkRelational:
+
+    def _people(self):
+        from windflow_tpu.models import nexmark as nx
+        return (nx.synth_persons(60, n_cities=5),
+                nx.synth_auctions(80, n_sellers=40, n_categories=4),
+                nx.synth_bids(400, n_auctions=80))
+
+    def test_q1_q2_numpy(self):
+        from windflow_tpu.core.tuples import TupleBatch
+        from windflow_tpu.models.nexmark import (DOL_TO_EUR,
+                                                 make_q2_selection,
+                                                 q1_currency,
+                                                 synth_bids)
+        pool = synth_bids(1000, n_auctions=20)
+        tb = TupleBatch({"key": pool["auction"], "id": pool["ts"],
+                         "ts": pool["ts"], "value": pool["price"]})
+        np.testing.assert_allclose(q1_currency(tb)["value"],
+                                   pool["price"] * DOL_TO_EUR)
+        mask = make_q2_selection({1, 2})(tb)
+        assert mask.sum() == np.isin(pool["auction"], [1, 2]).sum()
+
+    def test_q3_local_items(self):
+        from windflow_tpu.models import nexmark as nx
+        persons, auctions, _ = self._people()
+        out = _Acc()
+        g = wf.PipeGraph("q3", Mode.DEFAULT)
+        nx.build_q3_local_items(g, persons, auctions,
+                                out, cities=(0, 1), category=2)
+        g.run()
+        got = sorted((k, v[0], v[1]) for k, _i, _t, v in out.items)
+        assert got == nx.q3_oracle(persons, auctions,
+                                   cities=(0, 1), category=2)
+        assert got   # non-vacuous
+
+    @pytest.mark.parametrize("q", ["q4", "q6"])
+    def test_q4_q6_avg_closing_price(self, q):
+        from windflow_tpu.models import nexmark as nx
+        _, auctions, bids = self._people()
+        out = {}
+
+        def sink(rec):
+            if rec is not None:
+                out[(rec.key, int(rec.ts))] = rec.value
+
+        g = wf.PipeGraph(q, Mode.DEFAULT)
+        build = (nx.build_q4_avg_price if q == "q4"
+                 else nx.build_q6_avg_seller)
+        oracle = nx.q4_oracle if q == "q4" else nx.q6_oracle
+        build(g, auctions, bids, 40, sink)
+        g.run()
+        expect = oracle(auctions, bids, 40)
+        assert out == expect and expect
+
+    def test_q8_new_users(self):
+        from windflow_tpu.models import nexmark as nx
+        persons, auctions, _ = self._people()
+        out = _Acc()
+        g = wf.PipeGraph("q8", Mode.DEFAULT)
+        nx.build_q8_new_users(g, persons, auctions, 50, out)
+        g.run()
+        got = sorted((k, int(ts), v[0], v[1])
+                     for k, _i, ts, v in out.items)
+        expect = nx.q8_oracle(persons, auctions, 50)
+        assert got == expect and expect
+
+    def test_baseline_twins_are_the_oracles(self):
+        from windflow_tpu.models import nexmark as nx
+        assert nx.q3_baseline is nx.q3_oracle
+        assert nx.q4_baseline is nx.q4_oracle
+        assert nx.q6_baseline is nx.q6_oracle
+        assert nx.q8_baseline is nx.q8_oracle
+
+
+# ---------------------------------------------------------------------------
+# chaos: session windows under exactly-once epochs with a mid-stream
+# crash match the uninterrupted oracle (zero lost/dup, ledger balanced)
+# ---------------------------------------------------------------------------
+
+K_CHAOS, B_CHAOS, L_CHAOS = 6, 100, 4
+
+
+def _chaos_events():
+    """Globally ts-ordered bursts: (key, block) is one session of
+    L_CHAOS tuples; blocks 10 ticks apart (gap 2 closes them)."""
+    events = []
+    i = 0
+    for b in range(B_CHAOS):
+        for j in range(L_CHAOS):
+            for k in range(K_CHAOS):
+                events.append((k, i, float(b * 10 + j),
+                               float((b + k + j) % 7)))
+                i += 1
+    return events
+
+
+def _session_oracle(events, gap):
+    by_key = collections.defaultdict(list)
+    for k, tid, ts, v in events:
+        by_key[k].append((ts, tid, v))
+    out = set()
+    for k, rows in by_key.items():
+        rows.sort()
+        cur = [rows[0]]
+        for r in rows[1:]:
+            if r[0] - cur[-1][0] <= gap:
+                cur.append(r)
+            else:
+                out.add((k, len(cur), cur[0][0],
+                         _sum([x[2] for x in cur])))
+                cur = [r]
+        out.add((k, len(cur), cur[0][0], _sum([x[2] for x in cur])))
+    return out
+
+
+class _WmCkptLogic(SourceLoopLogic):
+    """Offset-checkpointable watermarked record source: the wrapper's
+    watermark clock rides state_dict next to the body offset, so an
+    epoch restore resumes promises consistent with the replayed
+    position (the WatermarkedSource checkpoint contract)."""
+
+    def __init__(self, events, every=16, pace_every=32, pace_s=0.004):
+        outer = self
+
+        class _Body:
+            def __init__(self):
+                self.i = 0
+
+            def __call__(self, shipper):
+                i = self.i
+                if i >= len(events):
+                    return False
+                if pace_every and i % pace_every == 0:
+                    time.sleep(pace_s)
+                k, tid, ts, v = events[i]
+                shipper.push(BasicRecord(k, tid, ts, v))
+                self.i = i + 1
+                return True
+
+            def state_dict(self):
+                return {"i": self.i}
+
+            def load_state(self, st):
+                self.i = st["i"]
+
+        self.wrapped = WatermarkedSource(_Body(), every=every)
+
+        def step(emit):
+            class _Ship:
+                def push(self, item):
+                    emit(item)
+            return outer.wrapped(_Ship())
+
+        super().__init__(step)
+
+    def state_dict(self):
+        return self.wrapped.state_dict()
+
+    def load_state(self, st):
+        self.wrapped.load_state(st)
+
+    def progress_frontier(self):
+        return self.wrapped.fn.i
+
+
+def _wm_ckpt_source(events, **kw):
+    from windflow_tpu.core.basic import Pattern, RoutingMode
+    from windflow_tpu.operators.base import Operator, StageSpec
+    from windflow_tpu.runtime.emitters import StandardEmitter
+
+    class _Src(Operator):
+        def __init__(self):
+            super().__init__("wm_source", 1, RoutingMode.NONE,
+                             Pattern.SOURCE)
+
+        def stages(self):
+            return [StageSpec(self.name, [_WmCkptLogic(events, **kw)],
+                              StandardEmitter(), self.routing)]
+
+    return _Src()
+
+
+@pytest.mark.slow
+def test_chaos_session_crash_under_epochs_exactly_once(tmp_path):
+    """FaultPlan kills a session-window replica mid-stream under
+    exactly-once epochs: after the supervised restart the fired
+    sessions equal the uninterrupted oracle bitwise -- zero lost, zero
+    duplicated, watermark clock restored with the source offset, and
+    the conservation ledger balanced across the restart."""
+    events = _chaos_events()
+    n_sessions = K_CHAOS * B_CHAOS
+    effects = []
+
+    def sink(rec):
+        if rec is not None:
+            effects.append((rec.key, rec.id, rec.ts, rec.value))
+
+    def factory(attempt):
+        plan = (FaultPlan(seed=23).crash_replica("session_window",
+                                                 at_tuple=900)
+                if attempt == 0 else None)
+        cfg = wf.RuntimeConfig(
+            durability=DurabilityConfig(
+                epoch_interval_s=0.03,
+                path=os.path.join(str(tmp_path), "epochs")),
+            fault_plan=plan)
+        g = wf.PipeGraph("ev_chaos", Mode.DEFAULT, config=cfg)
+        g.add_source(_wm_ckpt_source(events)) \
+            .add(SessionWindow(_sum, gap=2.0, parallelism=2)) \
+            .add_sink(wf.SinkBuilder(sink).with_exactly_once().build())
+        return g
+
+    g = run_with_epochs(factory, max_restarts=2)
+    assert getattr(g, "_epoch_restored", None) is not None
+    assert len(effects) == n_sessions, len(effects)
+    assert len(set(effects)) == n_sessions, "duplicated sessions"
+    assert set(effects) == _session_oracle(events, gap=2.0)
+    assert g.dead_letters.count() == 0   # nothing falsely late
+    cons = json.loads(g.stats.to_json())["Conservation"]
+    assert cons["Violations_total"] == 0, cons["Violations"]
+    assert cons["Edges_balanced"], cons
+    # (the 1:1 Sources==Sinks identity does not apply: sessions
+    # collapse many inputs into one fired record per session)
+
+
+# ---------------------------------------------------------------------------
+# elasticity: join keyed state survives mid-stream rescale
+# ---------------------------------------------------------------------------
+
+def _paced_events_source(events, state, every=32, pace_every=64,
+                         pace_s=0.002):
+    def body(shipper):
+        i = state["i"]
+        if i >= len(events):
+            return False
+        if pace_every and i % pace_every == 0:
+            time.sleep(pace_s)
+        k, tid, ts, v = events[i]
+        shipper.push(BasicRecord(k, tid, ts, v))
+        state["i"] = i + 1
+        return True
+
+    return watermarked(body, every=every)
+
+
+def _wait_progress(state, upto, deadline_s=30.0):
+    deadline = time.monotonic() + deadline_s
+    while state["i"] < upto:
+        assert time.monotonic() < deadline, "source made no progress"
+        time.sleep(0.002)
+
+
+def _run_join_rescale(n, rescale_steps):
+    left = [(i % 8, i, float(i), ("L", i)) for i in range(n)]
+    right = [(i % 8, i, float(i), ("R", i)) for i in range(n)]
+    got = _Acc()
+    st_l, st_r = {"i": 0}, {"i": 0}
+    from windflow_tpu.elastic import ElasticityConfig
+    g = wf.PipeGraph("ev_rescale", Mode.DEFAULT,
+                     config=wf.RuntimeConfig(
+                         elasticity=ElasticityConfig(enabled=False)))
+    pace = dict(pace_every=64, pace_s=0.002) if rescale_steps \
+        else dict(pace_every=0)
+    p1 = g.add_source(wf.SourceBuilder(
+        _paced_events_source(left, st_l, **pace)).build())
+    p1.chain(tag_side(LEFT))
+    p2 = g.add_source(wf.SourceBuilder(
+        _paced_events_source(right, st_r, **pace)).build())
+    p2.chain(tag_side(RIGHT))
+    op = WindowJoin(16.0, name="wjoin")
+    op.elasticity = ElasticSpec(1, 4)
+    p1.merge(p2).add(op).add_sink(Sink(got))
+    if not rescale_steps:
+        g.run()
+        return got
+    g.start()
+    _wait_progress(st_l, n // 3)
+    ev1 = g.rescale("wjoin", 3, trigger="scripted step")
+    _wait_progress(st_l, 2 * n // 3)
+    ev2 = g.rescale("wjoin", 1, trigger="scripted step")
+    g.wait_end()
+    assert (ev1.old_parallelism, ev1.new_parallelism) == (1, 3)
+    assert (ev2.old_parallelism, ev2.new_parallelism) == (3, 1)
+    return got
+
+
+@pytest.mark.slow
+def test_join_rescale_conserves_buffered_state():
+    """WindowJoin scales 1->3->1 mid-stream: the keyed two-sided
+    buffers repartition through the drain barrier, and the joined
+    output equals the fixed-parallelism run -- zero lost or duplicated
+    pairs across both migrations."""
+    n = 4000
+    ref = _run_join_rescale(n, rescale_steps=False)
+    got = _run_join_rescale(n, rescale_steps=True)
+    assert len(got.items) == len(ref.items)
+    assert sorted(got.items) == sorted(ref.items)
+
+
+# ---------------------------------------------------------------------------
+# export surfaces: /metrics families + the schema-10 doctor golden
+# ---------------------------------------------------------------------------
+
+def test_openmetrics_eventtime_families():
+    from windflow_tpu.telemetry.metrics import render_openmetrics
+    apps = {1: {"active": True, "report": {
+        "PipeGraph_name": "ev",
+        "Operators": [
+            {"Operator_name": "pipe0/session_window", "Parallelism": 2,
+             "Replicas": [{"Late_tuples": 4, "Sessions_open": 3},
+                          {"Late_tuples": 3, "Sessions_open": 2}]},
+            {"Operator_name": "pipe0/interval_join", "Parallelism": 1,
+             "Replicas": [{"Join_state_keys": 42}]},
+            {"Operator_name": "pipe0/map", "Parallelism": 1,
+             "Replicas": [{"Inputs_received": 5}]},
+        ],
+    }}}
+    text = render_openmetrics(apps)
+    assert ('windflow_late_tuples_total{app="1",graph="ev",'
+            'operator="pipe0/session_window"} 7') in text
+    assert ('windflow_sessions_open{app="1",graph="ev",'
+            'operator="pipe0/session_window"} 5') in text
+    assert ('windflow_join_state_keys{app="1",graph="ev",'
+            'operator="pipe0/interval_join"} 42') in text
+    # absent on non-event-time operators (gauge vs counter semantics)
+    for fam in ("windflow_late_tuples_total", "windflow_sessions_open",
+                "windflow_join_state_keys"):
+        assert f'{fam}{{app="1",graph="ev",operator="pipe0/map"}}' \
+            not in text
+
+
+def test_doctor_golden_v10_eventtime_gauges(capsys):
+    """Schema-10 dump (event-time gauges + late_data flight events) ->
+    doctor --json report pinned by the committed golden pair."""
+    golden_dir = os.path.join(os.path.dirname(__file__), "golden")
+    from windflow_tpu.doctor import main as doctor_main
+    path = os.path.join(golden_dir, "doctor_stats_v10.json")
+    rc = doctor_main([path, "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    rep = json.loads(out)
+    src = rep.pop("Source")
+    assert src.endswith("doctor_stats_v10.json")
+    with open(os.path.join(golden_dir, "doctor_report_v10.json")) as f:
+        golden = json.load(f)
+    assert rep == golden
+    # the v10 dump's event-time extras flow through loading untouched
+    with open(path) as f:
+        dump = json.load(f)
+    assert dump["Schema_version"] == 10
+    sess = next(o for o in dump["Operators"]
+                if o["Operator_name"] == "pipe0/session_window")
+    assert sum(r["Late_tuples"] for r in sess["Replicas"]) == 7

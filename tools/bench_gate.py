@@ -151,6 +151,19 @@ def measure() -> tuple:
         r2, _ = bench.run_nexmark(q, N_NEX, opt_level=OptLevel.LEVEL2)
         out[f"6_nexmark_{q}_unfused"] = round(r0, 1)
         out[f"6_nexmark_{q}"] = round(r2, 1)
+    # event-time relational smoke (docs/EVENTTIME.md): NEXMark Q4 + Q8
+    # through the watermark-triggered join plane; the helper itself
+    # asserts both queries against their numpy oracle twins and that
+    # every planted straggler was quarantined loudly (dead letters +
+    # late_data flight events).  The gated rate catches a wedged
+    # watermark/fire path; p50/p99 gate watermark-to-result latency.
+    r18 = bench.run_nexmark_joins(N_NEX // 25)
+    assert r18["late"]["quarantined"] == r18["late"]["planted"], \
+        "late lane lost stragglers silently"
+    out["18_nexmark_joins"] = r18["rate"]
+    if r18["p99_ms"] is not None:
+        lats["18_nexmark_joins"] = {"p50_ms": r18["p50_ms"],
+                                    "p99_ms": r18["p99_ms"]}
     r0, _ = bench.run_record_chain_host(50_000, opt_level=OptLevel.LEVEL0)
     r2, _ = bench.run_record_chain_host(50_000, opt_level=OptLevel.LEVEL2)
     out["7_record_chain_host_unfused"] = round(r0, 1)

@@ -94,6 +94,22 @@ def __getattr__(name):
         "EpochTaggedStore": "windflow_tpu.durability",
         "run_with_epochs": "windflow_tpu.durability",
         "restore_epoch": "windflow_tpu.durability",
+        # event-time relational plane (eventtime/; docs/EVENTTIME.md)
+        "Watermark": "windflow_tpu.eventtime",
+        "watermarked": "windflow_tpu.eventtime",
+        "WatermarkedSource": "windflow_tpu.eventtime",
+        "watermark_of": "windflow_tpu.audit.progress",
+        "EventTimeWindow": "windflow_tpu.eventtime",
+        "SessionWindow": "windflow_tpu.eventtime",
+        "IntervalJoin": "windflow_tpu.eventtime",
+        "WindowJoin": "windflow_tpu.eventtime",
+        "Sided": "windflow_tpu.eventtime",
+        "side_tagger": "windflow_tpu.eventtime",
+        "tag_side": "windflow_tpu.eventtime",
+        "LEFT": "windflow_tpu.eventtime",
+        "RIGHT": "windflow_tpu.eventtime",
+        "StreamQuery": "windflow_tpu.eventtime",
+        "query": "windflow_tpu.eventtime",
         # mesh-scale operators + mesh construction (multi-chip plane)
         "KeyFarmMesh": "windflow_tpu.operators.tpu.mesh_farm",
         "PaneFarmMesh": "windflow_tpu.operators.tpu.pane_mesh",

@@ -357,9 +357,13 @@ class FlowLedger:
                 # but are control items, not stream tuples -- the
                 # graph-wide identity subtracts them on both ends
                 sources_emitted -= getattr(n, "epoch_barriers_out", 0)
+                # event-time plane: watermarks ride the same outlet
+                # send path as barriers and get the same subtraction
+                sources_emitted -= getattr(n, "watermarks_out", 0)
             elif not n.outlets:
                 sinks_consumed += getattr(n.channel, "gets", 0)
                 sinks_consumed -= getattr(n, "epoch_barriers_in", 0)
+                sinks_consumed -= getattr(n, "watermarks_in", 0)
             processing += max(0, n.taken - n.done)
             probe = getattr(n.logic, "audit_in_flight", None)
             if probe is not None:

@@ -60,6 +60,27 @@ def source_frontier(node) -> float:
     return float(total)
 
 
+def watermark_of(source) -> float:
+    """The current EVENT-TIME promise of a source (eventtime/;
+    docs/EVENTTIME.md) -- distinct from the transport frontier above,
+    which counts items, not event time.
+
+    Accepts a :class:`~windflow_tpu.eventtime.watermarks.
+    WatermarkedSource` (or anything exposing ``current_watermark``),
+    a running RtNode (its last min-merged outbound watermark), or any
+    node as a fallback through :func:`source_frontier`.  Returns
+    ``-inf`` before the first promise."""
+    wm = getattr(source, "current_watermark", None)
+    if wm is not None:
+        return float(wm)
+    out = getattr(source, "_wm_out_ts", None)
+    if out is not None and out > float("-inf"):
+        return float(out)
+    if hasattr(source, "outlets"):
+        return source_frontier(source)
+    return float("-inf")
+
+
 class _Progress:
     __slots__ = ("wm", "wm_t", "last_done", "stall_reported")
 

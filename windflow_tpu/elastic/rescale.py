@@ -244,6 +244,16 @@ def rescale_operator(graph, handle: ElasticHandle, new_n: int,
                         f"{type(node.logic).__name__} cannot load "
                         "keyed state")
                 node.logic.load_keyed_state(parts[i])
+            for node in old_nodes[new_n:]:
+                # the snapshot above is shallow: the survivors' loaded
+                # partitions alias the retiring replicas' inner state
+                # objects.  Clear the retiring copies before their EOS
+                # unwind -- a keyed logic with a destructive eos_flush
+                # (event-time windows/joins fire-and-pop) would
+                # otherwise re-fire the migrated windows AND mutate
+                # state now owned by a survivor
+                if _can_load_keyed(node.logic):
+                    node.logic.load_keyed_state({})
         handle.replicas = new_replicas
         graph.stats.set_parallelism(handle.name, new_n)
         for node in added:

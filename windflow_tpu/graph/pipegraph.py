@@ -347,6 +347,12 @@ class PipeGraph:
             # consumers stamp hops / close traces)
             n.flight = self.flight
             n.logic.flight = self.flight
+            if getattr(n.logic, "uses_dead_letters", False):
+                # late-data quarantine (eventtime/ logics, K-slack
+                # collectors): the logic itself dead-letters event-time
+                # drops with its runtime identity attached
+                n.logic.dead_letters = self.dead_letters
+                n.logic.node_name = n.name
             if hub is not None:
                 n.telemetry = hub
                 n.logic.telemetry = hub
@@ -373,6 +379,9 @@ class PipeGraph:
                 for seg in n.logic.segments:
                     seg.dead_letters = self.dead_letters
                     seg.logic.flight = self.flight
+                    if getattr(seg.logic, "uses_dead_letters", False):
+                        seg.logic.dead_letters = self.dead_letters
+                        seg.logic.node_name = seg.name
                     if hub is not None:
                         seg.logic.telemetry = hub
                     if fault_plan is not None:

@@ -38,10 +38,15 @@ from ..telemetry.histogram import LogHistogram
 # "tiers" key/byte splits plus spills / spill_bytes / promotions /
 # demotions / sheds counters -- state/tiers.py census()) and
 # Skew.Hot_keys entries may name each hot key's tier ("tiers").
+# 10 = replica records may carry event-time plane gauges
+# (eventtime/; docs/EVENTTIME.md): Late_tuples (allowed-lateness
+# misses quarantined to dead letters), Sessions_open (live gap
+# sessions) and Join_state_keys (keys with buffered join state) --
+# emitted only when nonzero.
 # Readers (doctor CLI, dashboard /explain, tests) must tolerate MISSING
 # blocks rather than dispatch on this number: older dumps carry no
 # version field at all, and every block is optional by contract.
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 
 
 @dataclass
@@ -117,6 +122,13 @@ class StatsRecord:
     # held back while work was pending
     frontier: float = 0.0
     frontier_lag_ms: float = 0.0
+    # event-time plane gauges (eventtime/; docs/EVENTTIME.md), written
+    # inline by the event-time logics: tuples behind the allowed-
+    # lateness horizon (quarantined, never silently dropped), live gap
+    # sessions, and keys holding buffered join state
+    late_tuples: int = 0
+    sessions_open: int = 0
+    join_state_keys: int = 0
     # telemetry plane (telemetry/; docs/OBSERVABILITY.md): per-replica
     # single-writer log-bucketed latency histograms, merged across
     # replicas at report time.  ``service`` is fed by the sampled
@@ -172,6 +184,13 @@ class StatsRecord:
         }
         if self.device_state_bytes:
             d["Device_state_bytes_resident"] = self.device_state_bytes
+        # event-time plane gauges: nonzero only on eventtime/ replicas
+        if self.late_tuples:
+            d["Late_tuples"] = self.late_tuples
+        if self.sessions_open:
+            d["Sessions_open"] = self.sessions_open
+        if self.join_state_keys:
+            d["Join_state_keys"] = self.join_state_keys
         if self.num_launches:
             # per-launch derivations + the roofline estimate: achieved
             # bytes/s over the launch wall time as a fraction of the

@@ -15,6 +15,7 @@ import numpy as np
 from ..core.meta import default_hash
 from ..core.tuples import TupleBatch
 from .node import EOSMarker
+from .queues import Watermark
 
 SendTo = Callable[[int, Any], None]
 
@@ -62,6 +63,11 @@ class StandardEmitter(Emitter):
             if self.keyed and self.key_sketch is not None:
                 self._observe_keys(item)
             send_to(0, item)
+        elif isinstance(item, Watermark):
+            # event-time control item: every destination must observe
+            # the low-watermark (eventtime/; docs/EVENTTIME.md)
+            for d in range(self.n_dest):
+                send_to(d, item)
         elif isinstance(item, TupleBatch):
             if not self.keyed:
                 send_to(self._rr, item)  # whole-batch round robin
@@ -114,7 +120,13 @@ class StandardEmitter(Emitter):
         pool = self.pool
         sk = self.key_sketch if self.keyed else None
         for item in items:
-            if isinstance(item, TupleBatch):
+            if isinstance(item, Watermark):
+                # broadcast within the buffered run: appending to every
+                # bucket preserves each destination's arrival order
+                # relative to the surrounding data items
+                for d in range(n):
+                    buckets.setdefault(d, []).append(item)
+            elif isinstance(item, TupleBatch):
                 if not self.keyed:
                     d = self._rr
                     self._rr = (self._rr + 1) % n
@@ -179,7 +191,7 @@ class SplittingEmitter(Emitter):
         self.n_branches = n_branches
 
     def emit(self, item, send_to):
-        if isinstance(item, EOSMarker):
+        if isinstance(item, (EOSMarker, Watermark)):
             for d in range(self.n_dest):
                 send_to(d, item)
             return

@@ -19,7 +19,7 @@ from ..core.tuples import SynthChunk
 from ..resilience.cancel import GraphCancelled
 from ..resilience.policies import POLICY_DEAD_LETTER, POLICY_FAIL
 from ..telemetry.trace import attach_if_absent
-from .queues import Channel, CHANNEL_TIMEOUT, GET_MANY_MAX
+from .queues import Channel, CHANNEL_TIMEOUT, GET_MANY_MAX, Watermark
 
 
 class EOSMarker:
@@ -104,6 +104,16 @@ class NodeLogic:
         logics."""
         return None
 
+    # -- event-time hook (eventtime/; docs/EVENTTIME.md).  A logic that
+    # DEFINES ``on_watermark(wm, emit)`` receives every advanced
+    # min-merged watermark before the runtime forwards it downstream
+    # (fire windows / close sessions / evict join state -- emissions
+    # precede the watermark in every destination channel).  Logics
+    # without the hook never see watermarks: the RtNode intercepts and
+    # forwards them generically.  Deliberately NOT defined on the base
+    # class so ``getattr(logic, "on_watermark", None)`` stays a cheap
+    # one-time probe.
+
 
 class ChainedLogic(NodeLogic):
     """Thread fusion of two logics: b consumes a's emissions inline
@@ -145,12 +155,33 @@ class ChainedLogic(NodeLogic):
         self.a.svc_init()
         self.b.svc_init()
 
+    def _feed_b(self, x, emit):
+        # watermarks emitted inside the chain (a watermarked source
+        # half) must not reach b.svc: offer b's event-time hook, then
+        # pass the watermark through (eventtime/; docs/EVENTTIME.md)
+        if isinstance(x, Watermark):
+            hook = getattr(self.b, "on_watermark", None)
+            if hook is not None:
+                hook(x, emit)
+            emit(x)
+            return
+        self.b.svc(x, 0, emit)
+
     def svc(self, item, channel_id, emit):
         self.a.svc(item, channel_id,
-                   lambda x: self.b.svc(x, 0, emit))
+                   lambda x: self._feed_b(x, emit))
+
+    def on_watermark(self, wm, emit):
+        """Channel watermark: both halves observe it in chain order."""
+        ha = getattr(self.a, "on_watermark", None)
+        if ha is not None:
+            ha(wm, lambda x: self._feed_b(x, emit))
+        hb = getattr(self.b, "on_watermark", None)
+        if hb is not None:
+            hb(wm, emit)
 
     def eos_flush(self, emit):
-        self.a.eos_flush(lambda x: self.b.svc(x, 0, emit))
+        self.a.eos_flush(lambda x: self._feed_b(x, emit))
         self.b.eos_flush(emit)
 
     def svc_end(self):
@@ -332,6 +363,16 @@ class FusedLogic(NodeLogic):
         inherit = getattr(seg.logic, "sync_emit", True)
 
         def entry(item, cid):
+            if isinstance(item, Watermark):
+                # event-time control item generated INSIDE the chain (a
+                # fused watermarked source head): offer this segment's
+                # hook, then pass it through -- it must never reach a
+                # plain segment's svc (docs/EVENTTIME.md)
+                hook = getattr(seg.logic, "on_watermark", None)
+                if hook is not None:
+                    hook(item, exit_)
+                exit_(item)
+                return
             if isinstance(item, SynthChunk) and not seg.accepts_chunks:
                 item = item.materialize(self.pool)  # plane boundary
             seg.taken += 1
@@ -404,6 +445,20 @@ class FusedLogic(NodeLogic):
                     self._obs_left = 1 if st0.samples < 64 else 16
                     return
             self._entry0(item, channel_id)
+        except _FusedDownstreamError as w:
+            raise w.error
+
+    def on_watermark(self, wm, emit):
+        """Channel watermark against a fused node: every segment with
+        the event-time hook observes it in chain order, emissions
+        feeding the downstream segments inline (the runtime forwards
+        the watermark itself afterwards, like any other logic)."""
+        self._emit_out = emit
+        try:
+            for k, seg in enumerate(self.segments):
+                hook = getattr(seg.logic, "on_watermark", None)
+                if hook is not None:
+                    hook(wm, self._exits[k])
         except _FusedDownstreamError as w:
             raise w.error
 
@@ -705,6 +760,16 @@ class RtNode(threading.Thread):
         self.epochs = None
         self.epoch_barriers_in = 0
         self.epoch_barriers_out = 0
+        # event-time plane (eventtime/; docs/EVENTTIME.md): per-producer
+        # watermark maxima, the min-merged watermark last forwarded, the
+        # logic's resolved on_watermark hook, and the control-item
+        # counters the ledger's graph-wide roll-up subtracts (exactly
+        # like the epoch-barrier pair above).  The per-producer map is
+        # deliberately NOT checkpointed: watermarks regenerate from the
+        # replayed data and the merge is monotone from -inf.
+        self._wm_chan: dict = {}
+        self._wm_out_ts = float("-inf")
+        self._wm_hook = None
         # supervised replica self-healing (durability/supervision.py):
         # the graph ReplicaSupervisor and this replica's group key,
         # bound at start for .with_restartable() stages under
@@ -714,6 +779,8 @@ class RtNode(threading.Thread):
         self.supervisor = None
         self.supervised_group = None
         self._supervised_handoff = False
+        self.watermarks_in = 0
+        self.watermarks_out = 0
         self._accepts_chunks = False  # resolved per thread (durable path)
         self._sync_emit = True
 
@@ -733,6 +800,13 @@ class RtNode(threading.Thread):
                 o.faults = f
 
     def _emit(self, item: Any) -> None:
+        if isinstance(item, Watermark):
+            # event-time control item leaving this node: emitters
+            # broadcast it to every destination, so count one per
+            # destination cell -- the same shape as the per-edge
+            # delivery books the ledger subtracts it from
+            self.watermarks_out += sum(o.n_destinations
+                                       for o in self.outlets)
         s = self.trace_sampler
         if s is not None:         # source replica: 1-in-N trace starts
             s.maybe_attach(item)
@@ -816,6 +890,12 @@ class RtNode(threading.Thread):
         t0 = _time.perf_counter() if stats is not None else 0.0
         try:
             for cid, item in got:
+                if isinstance(item, Watermark):
+                    # buffered path: hook emissions and the forwarded
+                    # watermark ride the SAME buffer, so per-destination
+                    # order relative to surrounding data is preserved
+                    self._handle_watermark(cid, item, append)
+                    continue
                 if not accepts_chunks and isinstance(item, SynthChunk):
                     item = item.materialize(pool)  # plane boundary
                 self.taken += 1
@@ -869,12 +949,48 @@ class RtNode(threading.Thread):
             # one amortized observation per batch, not per tuple
             stats.observe((_time.perf_counter() - t0) * 1e6 / processed)
 
+    def _handle_watermark(self, cid: int, wm: Watermark, emit) -> None:
+        """Min-merge a watermark arriving on producer ``cid`` and, when
+        the merged low-watermark advances, offer it to the logic's
+        event-time hook and forward it downstream (eventtime/;
+        docs/EVENTTIME.md).  Emissions the hook produces go out BEFORE
+        the watermark -- per-channel FIFO then guarantees downstream
+        consumers see fired results before the trigger that fired them.
+        Watermarks advance no fault clock and neither ``taken`` nor
+        ``done``: they are control items, invisible to the quiesce
+        barrier's in-flight arithmetic (per-edge delivery books still
+        count them symmetrically; the ledger's graph-wide identity
+        subtracts ``watermarks_in/out`` at the sinks/sources)."""
+        self.watermarks_in += 1
+        m = self._wm_chan
+        prev = m.get(cid)
+        if prev is None or wm.ts > prev:
+            m[cid] = wm.ts
+        # the merged watermark is defined only once EVERY producer has
+        # reported one (min over a partial view would overshoot)
+        n_prod = getattr(self.channel, "n_producers", 1) or 1
+        if len(m) < n_prod:
+            return
+        cur = min(m.values())
+        if cur <= self._wm_out_ts:
+            return
+        self._wm_out_ts = cur
+        out = wm if wm.ts == cur else Watermark(cur)
+        hook = self._wm_hook
+        if hook is not None:
+            hook(out, emit)
+        if self.outlets:
+            emit(out)
+
     def _process_one(self, cid: int, item: Any) -> None:
         """One guarded svc call: the per-item consume body, factored
         out for the durability plane's dispatch path (barrier-aware
         routing + the aligner's held-item replay).  Must stay
         semantically identical to the inline loop below -- the inline
         copy exists so the epochs-off hot path pays no extra call."""
+        if isinstance(item, Watermark):
+            self._handle_watermark(cid, item, self._emit)
+            return
         if not self._accepts_chunks and isinstance(item, SynthChunk):
             item = item.materialize(self.pool)  # plane boundary
         self.taken += 1
@@ -919,6 +1035,9 @@ class RtNode(threading.Thread):
         aligner = self.epochs
         buffered = get_many is not None and sync_emit and aligner is None
         tele = self.telemetry
+        # event-time hook resolved once per thread (None on logics
+        # without it -- watermarks then just merge-and-forward)
+        self._wm_hook = getattr(self.logic, "on_watermark", None)
         self._accepts_chunks = accepts_chunks
         self._sync_emit = sync_emit
         timeout = 0.025 if tick else None
@@ -949,6 +1068,9 @@ class RtNode(threading.Thread):
                         process(cid, item)
                 continue
             for cid, item in got:
+                if isinstance(item, Watermark):
+                    self._handle_watermark(cid, item, self._emit)
+                    continue
                 if not accepts_chunks and isinstance(item, SynthChunk):
                     item = item.materialize(pool)  # plane boundary
                 self.taken += 1

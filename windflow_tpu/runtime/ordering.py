@@ -293,11 +293,30 @@ class OrderingLogic(NodeLogic):
                     self._emit_rec(st.eos_marker.record, emit, is_marker=True)
 
 
+class LateTupleDropped(Exception):
+    """Quarantine reason attached to event-time-dropped tuples: the
+    tuple's timestamp fell behind the already-emitted watermark (K-slack
+    late drop, kslack_node.hpp:193-200; eventtime/ allowed-lateness
+    misses reuse it)."""
+
+
 class KSlackLogic(NodeLogic):
     """PROBABILISTIC-mode collector: K-slack buffering with K adapted to
     the maximum observed delay; tuples older than the emitted watermark
     are dropped and counted (kslack_node.hpp:93-200).
+
+    Drop accounting (docs/EVENTTIME.md "Late data"): beyond the exact
+    ``dropped`` counter and the capped ``dropped_records`` identities,
+    every drop is quarantined in the graph dead-letter store with a
+    :class:`LateTupleDropped` reason and announced as a ``late_data``
+    flight event -- event-time loss is loud, never a silent counter.
+    ``dead_letters``/``node_name`` are bound by PipeGraph.start through
+    the ``uses_dead_letters`` marker (None outside a started graph).
     """
+
+    uses_dead_letters = True
+    dead_letters = None
+    node_name = "kslack"
 
     def __init__(self, mode: OrderingMode = OrderingMode.TS,
                  on_drop: Callable[[int], None] = None):
@@ -356,13 +375,14 @@ class KSlackLogic(NodeLogic):
         n_drop = int((~keep).sum())
         if n_drop:
             self.dropped += n_drop
+            d = out.take(~keep)
             room = self.dropped_records_cap - len(self.dropped_records)
             if room > 0:
-                d = out.take(~keep)
                 self.dropped_records.extend(
                     zip(d.key[:room].tolist(), d.id[:room].tolist(),
                         d.ts[:room].tolist()))
             self.on_drop(n_drop)
+            self._quarantine(d, n_drop)
             out = out.take(keep)
         if not len(out):
             return
@@ -373,6 +393,23 @@ class KSlackLogic(NodeLogic):
                 self.key_counters.__setitem__)
         emit(out)
 
+    def _quarantine(self, item, n: int) -> None:
+        """Loud accounting for ``n`` event-time drops: one dead-letter
+        entry per call (the columnar lane passes the whole dropped
+        sub-batch as the sample, like ingest shedding) plus a
+        ``late_data`` flight event naming the emitted watermark the
+        tuples fell behind."""
+        dl = self.dead_letters
+        if dl is not None:
+            dl.add(self.node_name, item,
+                   LateTupleDropped(
+                       f"event-time ts behind emitted watermark "
+                       f"{self.last_timestamp}"), count=n)
+        fl = self.flight
+        if fl is not None:
+            fl.record("late_data", node=self.node_name, n=n,
+                      watermark=self.last_timestamp)
+
     def _emit_in_order(self, recs, emit):
         for rec in recs:
             ts = rec.get_control_fields()[2]
@@ -381,6 +418,7 @@ class KSlackLogic(NodeLogic):
                 if len(self.dropped_records) < self.dropped_records_cap:
                     self.dropped_records.append(rec.get_control_fields())
                 self.on_drop(1)
+                self._quarantine(rec, 1)
                 continue
             self.last_timestamp = ts
             if self.mode == OrderingMode.TS_RENUMBERING:

@@ -52,6 +52,31 @@ class EpochBarrier:
         return ("EpochBarrier(final)" if self.final
                 else f"EpochBarrier({self.epoch})")
 
+class Watermark:
+    """Event-time low-watermark control item (eventtime/;
+    docs/EVENTTIME.md) -- the in-band trigger signal of the event-time
+    relational plane (Akidau et al., the Dataflow model).  A
+    ``Watermark(ts)`` is a promise from its producer that every FUTURE
+    item on this stream has event-time ``>= ts``.  Emitted by
+    watermarked sources (eventtime/watermarks.py), broadcast by every
+    emitter to all destinations, merged per consumer as the min over
+    its producers (runtime/node.py), and consumed by event-time logics
+    (``on_watermark``) to fire windows, close sessions and evict join
+    state.  Like :class:`EpochBarrier` it travels through both channel
+    planes as an ordinary item, so per-edge delivery books stay
+    balanced by construction; the graph-wide conservation identity
+    subtracts the per-node ``watermarks_in/out`` counters
+    (audit/ledger.py)."""
+
+    __slots__ = ("ts",)
+
+    def __init__(self, ts: float):
+        self.ts = ts
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"Watermark({self.ts})"
+
+
 # returned by get(timeout=...) when the wait elapses: distinct from
 # None (which means every producer closed)
 CHANNEL_TIMEOUT = object()

@@ -162,6 +162,29 @@ def render_openmetrics(apps: dict) -> str:
                    for r in reps), default=0.0)
         out.append(f"windflow_frontier_lag_seconds{_labels(**lab)} "
                    f"{lag / 1e3}")
+    # event-time plane (eventtime/; docs/EVENTTIME.md): lateness and
+    # event-time state gauges -- absent on non-event-time operators
+    # (the replica records emit them only when nonzero)
+    family("windflow_late_tuples", "counter",
+           "tuples behind the allowed-lateness horizon (quarantined "
+           "into the dead-letter store)")
+    for _op, reps, lab in per_op():
+        late = sum(int(r.get("Late_tuples", 0) or 0) for r in reps)
+        if late:
+            out.append(f"windflow_late_tuples_total{_labels(**lab)} "
+                       f"{late}")
+    family("windflow_sessions_open", "gauge",
+           "live gap sessions held by session-window replicas")
+    for _op, reps, lab in per_op():
+        if any("Sessions_open" in r for r in reps):
+            out.append(f"windflow_sessions_open{_labels(**lab)} "
+                       f"{sum(int(r.get('Sessions_open', 0) or 0) for r in reps)}")
+    family("windflow_join_state_keys", "gauge",
+           "keys holding buffered two-input join state")
+    for _op, reps, lab in per_op():
+        if any("Join_state_keys" in r for r in reps):
+            out.append(f"windflow_join_state_keys{_labels(**lab)} "
+                       f"{sum(int(r.get('Join_state_keys', 0) or 0) for r in reps)}")
     family("windflow_parallelism", "gauge", "live replica count")
     for op, reps, lab in per_op():
         out.append(f"windflow_parallelism{_labels(**lab)} "
