@@ -1775,6 +1775,112 @@ def run_replan_shift(n_events=1_200_000, source_batch=1500,
                 os.environ[k] = v
 
 
+def run_device_step(n_events, win=1024, slide=16, n_keys=8,
+                    source_batch=8192, batch_len=16, reps=2):
+    """Config #19_device_step: whole-partition device step on/off A/B
+    (graph/device_step.py; docs/RUNTIME.md "Whole-partition device
+    step").  The SAME keyed sliding-window pipeline (batch source ->
+    device window engine -> sink) runs with the step lowered -- source
+    merged in, one boundary flush per ingest chunk -- and with plain
+    LEVEL2 fusion, interleaved off/on per rep so box drift hits both
+    lanes equally.  The default shape is the launch-cadence-bound
+    regime the VERDICT flagged (device < host: tight batch_len, many
+    fired windows per chunk), where per-trigger dispatch dominates and
+    chunk-boundary grouping is the whole win.  Asserts
+    bitwise-identical window results every rep, and that the step lane
+    stayed at <= 2 launches per ingest chunk, from BOTH the step
+    logic's own chunk counters and the engine's dispatcher-side stats
+    launch counter.  Reports best-of-N rates per lane,
+    launches-per-chunk, and the step lane's window-result latency
+    p50/p99."""
+    import windflow_tpu as wf
+    from windflow_tpu.core.basic import RuntimeConfig
+    from windflow_tpu.core.tuples import TupleBatch
+    from windflow_tpu.graph.device_step import DeviceStepLogic
+    from windflow_tpu.operators.basic_ops import Sink
+    from windflow_tpu.operators.batch_ops import BatchSource
+    from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
+
+    def lane(step):
+        stamps = []
+        state = {"i": 0}
+
+        def batch():
+            i = state["i"]
+            if i >= n_events:
+                return None
+            state["i"] = i + source_batch
+            stamps.append(time.perf_counter())
+            idx = np.arange(i, min(i + source_batch, n_events))
+            return TupleBatch({
+                "key": idx % n_keys, "id": idx // n_keys,
+                "ts": idx // n_keys,
+                "value": (idx % 97).astype(np.float64)})
+
+        results = {}
+        lats = []
+        lock = threading.Lock()
+
+        def sink(r):
+            if r is None:
+                return
+            now = time.perf_counter()
+            with lock:
+                results[(r.key, r.id)] = r.value
+                closing = (r.id * slide + win - 1) * n_keys + r.key
+                ci = min(closing // source_batch, len(stamps) - 1)
+                if ci >= 0:
+                    lats.append(now - stamps[ci])
+
+        cfg = RuntimeConfig(device_step=step)
+        g = wf.PipeGraph("bench19", wf.Mode.DEFAULT, config=cfg)
+        op = WinSeqTPU("sum", win, slide, wf.WinType.CB,
+                       batch_len=batch_len, max_buffer_elems=MAX_BUFFER,
+                       inflight_depth=INFLIGHT,
+                       value_of=lambda t: t.value)
+        g.add_source(BatchSource(batch)).add(op).add_sink(Sink(sink))
+        t0 = time.perf_counter()
+        g.run()
+        dt = time.perf_counter() - t0
+        steps = [n.logic for n in g._all_nodes()
+                 if isinstance(n.logic, DeviceStepLogic)]
+        launches = 0
+        rep = json.loads(g.stats.to_json())
+        for o in rep["Operators"]:
+            for r in o["Replicas"]:
+                launches += r.get("Device_launches") or 0
+        return n_events / dt, results, lats, steps, launches
+
+    best = {False: 0.0, True: 0.0}
+    lpc = step_lats = None
+    for _ in range(reps):
+        off_rate, off_res, _lat0, off_steps, _l0 = lane(False)
+        on_rate, on_res, on_lat, on_steps, on_launches = lane(True)
+        assert off_res == on_res, (
+            f"device-step lane diverged: {len(off_res)} vs "
+            f"{len(on_res)} windows")
+        assert not off_steps and on_steps, \
+            "step should engage exactly when enabled"
+        chunks = sum(s.chunks_in for s in on_steps)
+        boundary = sum(s.chunk_launches for s in on_steps)
+        assert chunks > 0 and boundary <= 2 * chunks, (chunks, boundary)
+        # dispatcher-side counter: total launches (boundary + EOS
+        # drain) still average <= 2 per ingest chunk
+        lpc = round(on_launches / chunks, 3)
+        assert lpc <= 2.0, f"{on_launches} launches / {chunks} chunks"
+        best[False] = max(best[False], off_rate)
+        best[True] = max(best[True], on_rate)
+        step_lats = on_lat
+    return {
+        "step": {"rate": round(best[True], 1)},
+        "plain": {"rate": round(best[False], 1)},
+        "speedup": round(best[True] / best[False], 2),
+        "launches_per_chunk": lpc,
+        "windows": len(on_res),
+        "lats": step_lats,
+    }
+
+
 class _WmClock:
     """Wall-clock stamps of a watermarked source's emission boundaries:
     ``reached(x)`` is the first wall time the source's watermark was
@@ -2317,6 +2423,17 @@ def main():
     r18 = run_nexmark_joins(200_000)
     r18.pop("lats", None)
     configs["18_nexmark_joins"] = r18
+    # whole-partition device step (docs/RUNTIME.md "Whole-partition
+    # device step"): on/off interleaved A/B, results asserted bitwise
+    # identical, <=2 launches per ingest chunk asserted from both the
+    # step counters and the dispatcher's launch counter; best-of-3
+    # because the shared box swings run-to-run
+    r19 = run_device_step(N_EVENTS // 8, reps=3)
+    lat19 = r19.pop("lats")
+    p50s, p99s = _pcts(lat19)
+    configs["19_device_step"] = {
+        **r19, "rate": r19["step"]["rate"],
+        "window_latency_p50_ms": p50s, "window_latency_p99_ms": p99s}
     for name, c in configs.items():
         n_out = c.get("windows", c.get("records", 0))
         print(f"[bench] {name}: {c['rate']:,.0f} tuples/s "

@@ -502,3 +502,59 @@ def test_elastic_decide_scales_up_on_bottleneck_signal():
     # never up (proves the new trigger is what fired above)
     d0 = decide(LoadReport(**base), spec, cfg)
     assert d0 is None or d0[0] < 2 or d0[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# whole-partition device step: trace attribution (graph/device_step.py)
+# ---------------------------------------------------------------------------
+
+def test_trace_breakdown_parses_device_step_meta_hop():
+    """Device-step hops carry a 4th meta element (launch count +
+    bytes); the breakdown splits them exactly like a plain 3-element
+    device hop."""
+    meta = {"launches": 1, "bytes_in": 4096, "bytes_out": 512}
+    rec = {"e2e_ms": 10.0,
+           "hops": [["pipe0/win@device", 2.0, 8.0, meta]]}
+    bd = trace_breakdown(rec, rtt_floor_ms=1.5)
+    dev = bd["operators"]["pipe0/win"]
+    assert dev["device_transport"] == pytest.approx(1.5)
+    assert dev["device_compute"] == pytest.approx(4.5)
+    assert sum(bd["classes"].values()) == pytest.approx(10.0)
+
+
+def test_device_step_one_device_hop_per_chunk_share_sum(tmp_path):
+    """With the step active the whole partition runs as one replica:
+    traces still close, every device hop carries launch accounting
+    (ONE launch per boundary flush), and attribution shares still
+    cover ~100% of the traced span."""
+    from windflow_tpu.graph.device_step import DeviceStepLogic
+    from windflow_tpu.models.nexmark import build_q5_hot_items
+
+    g = wf.PipeGraph("diag_step", Mode.DEFAULT, diag_cfg(tmp_path))
+    sink = []
+    build_q5_hot_items(g, 60_000, 1 << 12, 1 << 11, sink.append,
+                       batch_size=4096, device_batch=512)
+    quiet_run(g)
+    steps = [n.logic for n in g._all_nodes()
+             if isinstance(n.logic, DeviceStepLogic)]
+    assert steps, "device step should be active"
+    assert steps[0].chunks_in > 0
+    # every traced device hop is a boundary flush: exactly one launch,
+    # with its byte accounting riding along
+    recs = [ctx.to_dict(t_end)
+            for ctx, t_end in list(g.stats.trace_records)]
+    dev_hops = [hop for rec in recs for hop in rec["hops"]
+                if str(hop[0]).endswith("@device")]
+    assert dev_hops, "sampled traces should cross the device lane"
+    for hop in dev_hops:
+        assert len(hop) > 3 and hop[3]["launches"] == 1, hop
+        assert hop[3]["bytes_in"] > 0 and hop[3]["bytes_out"] > 0
+    # at most one device hop per trace: one chunk, one launch
+    for rec in recs:
+        n_dev = sum(1 for hop in rec["hops"]
+                    if str(hop[0]).endswith("@device"))
+        assert n_dev <= 1, rec["hops"]
+    rep = g.explain()
+    attr = rep["Attribution"]
+    assert attr["Traces"] > 0
+    assert attr["Share_sum"] == pytest.approx(1.0, abs=0.02)

@@ -469,6 +469,74 @@ def test_watermarked_source_promise_and_checkpoint():
     assert ship.items[-1].ts == float("inf")
 
 
+def test_watermarked_auto_skew_learns_from_lateness():
+    """skew="auto": the promise starts at zero, jumps UP to cover any
+    observed lateness, decays slowly below it, records loud
+    ``skew_adapted`` flight events, and rides the checkpoint."""
+    from windflow_tpu.telemetry import FlightRecorder
+
+    # in-order prefix, then a tuple trailing the max ts by 8.0
+    events = [(0, i, float(i), 1.0) for i in range(8)] \
+        + [(0, 8, 0.0, 1.0)] + [(0, 9, 9.0, 1.0)]
+    src = _shipper_source(events, every=4, skew="auto")
+    src.flight = FlightRecorder(16)
+
+    class _Ship:
+        def __init__(self):
+            self.items = []
+
+        def push(self, item):
+            self.items.append(item)
+
+    ship = _Ship()
+    for _ in range(8):
+        assert src(ship)
+    assert src.skew == 0.0          # in-order stretch: nothing learned
+    assert src(ship)                # the late tuple (ts=0 vs max=7)
+    assert src.skew == pytest.approx(7.0)   # jumped straight up
+    evs = [e for e in src.flight.snapshot()
+           if e["kind"] == "skew_adapted"]
+    assert evs and evs[-1]["new"] == pytest.approx(7.0)
+    assert evs[-1]["observed"] == pytest.approx(7.0)
+    # a well-ordered stretch decays the bound slowly (never a cliff)
+    before = src.skew
+    src.fn = _shipper_source(
+        [(0, i, float(i + 10), 1.0) for i in range(4)], every=64).fn
+    skews = []
+    for _ in range(4):
+        src(ship)
+        skews.append(src.skew)
+    assert all(s < before for s in skews)
+    assert skews == sorted(skews, reverse=True)
+    assert skews[-1] > 0.0          # memory of the burst persists
+    # the learned bound survives a checkpoint roundtrip
+    st = src.state_dict()
+    clone = WatermarkedSource(lambda s: False, skew="auto")
+    clone.load_state(st)
+    assert clone.skew == pytest.approx(src.skew)
+    assert clone.auto_skew is True
+
+
+def test_watermarked_auto_skew_flight_event_in_graph(tmp_path):
+    """Graph-level: PipeGraph.start binds its flight recorder to the
+    watermarked source body, so the ``skew_adapted`` event lands in
+    ``g.flight`` with the source node's name attached."""
+    events = [(0, i, float(i), 1.0) for i in range(32)]
+    events[20] = (0, 20, 2.0, 1.0)   # one tuple 17 ticks late
+    got = _Acc()
+    g = wf.PipeGraph("ev_autoskew", Mode.DEFAULT)
+    g.add_source(wf.SourceBuilder(
+        _shipper_source(events, every=8, skew="auto")).build()) \
+        .add(EventTimeWindow(_sum, size=16.0)) \
+        .add_sink(Sink(got))
+    g.run()
+    evs = [e for e in g.flight.snapshot()
+           if e["kind"] == "skew_adapted"]
+    assert evs, "late tuple should have adapted the skew loudly"
+    assert evs[-1]["new"] > 0.0
+    assert evs[-1]["source"].startswith("pipe0/")
+
+
 def test_watermark_of_node_and_frontier_fallback():
     events = [(0, i, float(i), 1.0) for i in range(64)]
     got = _Acc()

@@ -11,14 +11,18 @@ import numpy as np
 import pytest
 
 import windflow_tpu as wf
-from windflow_tpu.core.basic import OptLevel, RuntimeConfig
+from windflow_tpu.core.basic import (OptLevel, Pattern, RoutingMode,
+                                     RuntimeConfig)
 from windflow_tpu.core.tuples import ColumnPool, TupleBatch
 from windflow_tpu.graph.fuse import find_logic, iter_logics
 from windflow_tpu.graph.pipegraph import NodeFailureError
+from windflow_tpu.operators.base import Operator, StageSpec
 from windflow_tpu.operators.basic_ops import Sink
 from windflow_tpu.operators.batch_ops import BatchMap, BatchSource
 from windflow_tpu.operators.tpu.win_seq_tpu import WinSeqTPU
 from windflow_tpu.resilience.faults import FaultPlan, InjectedFailure
+from windflow_tpu.runtime.emitters import StandardEmitter
+from windflow_tpu.runtime.node import SourceLoopLogic
 
 
 def record_source(n, n_keys=3):
@@ -483,3 +487,189 @@ def test_ingest_feed_fused_equivalence():
             assert eng is not None  # fusion-transparent lookup
     assert results[OptLevel.LEVEL0] == results[OptLevel.LEVEL2]
     assert results[OptLevel.LEVEL0], "no windows emitted"
+
+
+# ---------------------------------------------------------------------------
+# whole-partition device step (graph/device_step.py)
+# ---------------------------------------------------------------------------
+
+def _force_python(g):
+    for _name, logic in iter_logics(g):
+        if hasattr(logic, "_native"):
+            logic._native = None
+
+
+def _step_info(g):
+    from windflow_tpu.graph.device_step import DeviceStepLogic
+    return {n.name: (n.logic.chunks_in, n.logic.chunk_launches)
+            for n in g._all_nodes()
+            if isinstance(n.logic, DeviceStepLogic)}
+
+
+def _build_app(query, g, sink):
+    from windflow_tpu.models.nexmark import (build_q5_hot_items,
+                                             build_q7_highest_bid)
+    from windflow_tpu.models.yahoo import build_pipeline
+    if query == "q5":
+        build_q5_hot_items(g, 60_000, 1 << 12, 1 << 11, sink,
+                           batch_size=4096, device_batch=512)
+    elif query == "q7":
+        build_q7_highest_bid(g, 60_000, 1 << 12, sink,
+                             batch_size=4096, device_batch=512)
+    else:
+        build_pipeline(g, 60_000, batch_size=4096, device_batch=512,
+                       sink=sink)
+
+
+@pytest.mark.parametrize("force_python", [False, True])
+@pytest.mark.parametrize("query", ["q5", "q7", "yahoo"])
+def test_device_step_bitwise(query, force_python):
+    """Device-step graphs produce bitwise-identical sink results vs
+    the plain LEVEL2 graph, on both channel planes -- launch GROUPING
+    changes (one per ingest chunk), launched work does not.  The source
+    merges into the device segment, so the whole partition runs as one
+    chunk-stepped replica."""
+    results, infos = {}, {}
+    for step in (False, True):
+        sink = CollectSink()
+        g = wf.PipeGraph(f"step_{query}", wf.Mode.DEFAULT,
+                         config=cfg_for(OptLevel.LEVEL2,
+                                        device_step=step))
+        _build_app(query, g, sink)
+        if force_python:
+            _force_python(g)
+        g.run()
+        results[step] = sink.sorted()
+        infos[step] = _step_info(g)
+    assert results[True] == results[False]
+    assert results[True], "no windows emitted"
+    assert infos[True] and not infos[False]
+    ((_name, (chunks, launches)),) = infos[True].items()
+    assert chunks > 0
+    # the acceptance bound: at most 2 launches per ingest chunk
+    assert launches <= 2 * chunks, (chunks, launches)
+
+
+def test_device_step_crash_mid_chunk():
+    """A FaultPlan crash inside the step node fires mid-chunk: the
+    failure surfaces exactly like any fused crash (the boundary flush
+    of the dying chunk is skipped, never half-launched)."""
+    from windflow_tpu.models.nexmark import build_q5_hot_items
+    for step in (False, True):
+        with FaultPlan(seed=11).crash_replica("q5_counts",
+                                              at_tuple=5) as plan:
+            g = wf.PipeGraph("step_crash", wf.Mode.DEFAULT,
+                             config=cfg_for(OptLevel.LEVEL2,
+                                            fault_plan=plan,
+                                            device_step=step))
+            build_q5_hot_items(g, 60_000, 1 << 12, 1 << 11,
+                               CollectSink(), batch_size=4096,
+                               device_batch=512)
+            with pytest.raises(NodeFailureError) as ei:
+                g.run()
+            assert any(isinstance(e, InjectedFailure)
+                       for _, e in ei.value.errors), step
+
+
+class _CkptBatchSrcLogic(SourceLoopLogic):
+    """Offset-checkpointable paced BATCH source logic (the chunk-plane
+    twin of test_durability's CkptSource)."""
+
+    def __init__(self, n, batch=512, pace_s=0.002):
+        self.i = 0
+        self.n = n
+        self.batch = batch
+        self.pace_s = pace_s
+        super().__init__(self._step)
+
+    def _step(self, emit):
+        import time as _t
+        i = self.i
+        if i >= self.n:
+            return False
+        _t.sleep(self.pace_s)
+        m = min(self.batch, self.n - i)
+        idx = i + np.arange(m)
+        self.i = i + m
+        emit(TupleBatch({"key": idx % 4, "id": idx // 4,
+                         "ts": idx // 4,
+                         "value": (idx % 7).astype(np.float64)}))
+        return True
+
+    def state_dict(self):
+        return {"i": self.i}
+
+    def load_state(self, st):
+        self.i = st["i"]
+
+    def progress_frontier(self):
+        return self.i
+
+
+class CkptBatchSource(Operator):
+    def __init__(self, n, name="ckpt_bsrc"):
+        super().__init__(name, 1, RoutingMode.NONE, Pattern.SOURCE)
+        self.n = n
+
+    def stages(self):
+        return [StageSpec(self.name, [_CkptBatchSrcLogic(self.n)],
+                          StandardEmitter(), self.routing)]
+
+
+@pytest.mark.parametrize("force_python", [False, True])
+def test_device_step_epoch_kill_restart_bitwise(tmp_path, force_python):
+    """Exactly-once across a kill-restart with the step active, on both
+    channel planes: epoch barriers fence at the chunk boundary (the
+    injected barrier is a control item, never held), the restart
+    replays from the committed offset, and window results equal the
+    uninterrupted run's bitwise."""
+    from windflow_tpu.core import DurabilityConfig
+    from windflow_tpu.durability import run_with_epochs
+    N, WIN, SLIDE = 30_000, 256, 128
+
+    def run(path, fault):
+        wins, counts = {}, {}
+        graphs = []
+
+        def sink(r):
+            if r is None:
+                return
+            if isinstance(r, TupleBatch):
+                for j in range(len(r)):
+                    k = (int(r.key[j]), int(r.id[j]))
+                    wins[k] = float(r["value"][j])
+                    counts[k] = counts.get(k, 0) + 1
+                return
+            k = (r.key, r.id)
+            wins[k] = r.value
+            counts[k] = counts.get(k, 0) + 1
+
+        def factory(attempt):
+            plan = fault if attempt == 0 else None
+            cfg = cfg_for(OptLevel.LEVEL2,
+                          durability=DurabilityConfig(
+                              epoch_interval_s=0.03, path=path),
+                          fault_plan=plan)
+            g = wf.PipeGraph("step_dur", wf.Mode.DEFAULT, config=cfg)
+            op = WinSeqTPU("sum", WIN, SLIDE, wf.WinType.TB,
+                           batch_len=256, emit_batches=True,
+                           name="stepwin")
+            g.add_source(CkptBatchSource(N)).add(op) \
+                .add_sink(wf.SinkBuilder(sink).with_exactly_once()
+                          .build())
+            if force_python:
+                _force_python(g)
+            graphs.append(g)
+            return g
+
+        g = run_with_epochs(factory, max_restarts=2)
+        return g, wins, counts
+
+    _gr, ref, ref_counts = run(str(tmp_path / "ref"), None)
+    assert ref and max(ref_counts.values()) == 1
+    assert _step_info(_gr), "step should be active"
+    plan = FaultPlan(seed=13).crash_replica("stepwin", at_tuple=30)
+    g, wins, counts = run(str(tmp_path / "chaos"), plan)
+    assert getattr(g, "_epoch_restored", None) is not None
+    assert max(counts.values()) == 1, "duplicate window results"
+    assert wins == ref

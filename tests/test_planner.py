@@ -386,7 +386,7 @@ def test_finish_normalizes_launch_wall_by_inflight_depth():
     logic = WinSeqTPULogic("sum", WIN, SLIDE, wf.WinType.TB)
     logic._adaptive = AdaptiveBatcher(256, floor_ms=10.0, patience=1)
     t_sub = _t.perf_counter() - 0.080  # 80 ms wall, 8 deep => 10 ms each
-    logic._finish((_H(), [], t_sub, t_sub, 8), lambda *_: None)
+    logic._finish((_H(), [], t_sub, t_sub, 8, 0), lambda *_: None)
     # ~floor after normalization: a grow vote (raw 80 ms >= 8x floor
     # would have halved the batch)
     assert logic._adaptive.resizes == [("x2", 512)]

@@ -701,7 +701,8 @@ GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
 REPORT_KEYS = {
     "Graph", "Schema_version", "Verdict", "Bottleneck", "Attribution",
     "Anomalies", "Anomalies_total", "Slo", "Conservation",
-    "Durability", "Hot_keys", "History", "Failures", "Arbitrations",
+    "Durability", "Hot_keys", "State_tiers", "History", "Failures",
+    "Arbitrations",
     "Replacements", "Replica_restarts", "Recovery_fallbacks",
     "State_pressure", "Disk_full", "Flight_tail",
 }

@@ -427,7 +427,7 @@ def test_adaptive_resize_records_flight_event():
 
     for _ in range(2):  # launches near the floor -> x2 after patience
         lg._finish((_Handle(), [], time.perf_counter(),
-                    time.perf_counter(), 1), lambda x: None)
+                    time.perf_counter(), 1, 0), lambda x: None)
     assert lg.batch_len == 512
     assert any(e["kind"] == "batch_resize" and e["new_len"] == 512
                for e in lg.flight.snapshot())

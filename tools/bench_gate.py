@@ -164,6 +164,17 @@ def measure() -> tuple:
     if r18["p99_ms"] is not None:
         lats["18_nexmark_joins"] = {"p50_ms": r18["p50_ms"],
                                     "p99_ms": r18["p99_ms"]}
+    # whole-partition device-step smoke (docs/RUNTIME.md "Whole-
+    # partition device step"): the helper itself asserts the on/off
+    # interleaved lanes bitwise identical, that the step engages
+    # exactly when enabled, and <=2 launches per ingest chunk (step
+    # counters + dispatcher launch counter); the gated rate catches a
+    # wedged chunk-flush path, p50/p99 gate boundary-flush latency
+    r19 = bench.run_device_step(N_SMALL // 2)
+    assert r19["launches_per_chunk"] <= 2.0
+    out["19_device_step"] = r19["step"]["rate"]
+    out["19_plain_fused"] = r19["plain"]["rate"]
+    lats["19_device_step"] = _pcts_ms(r19["lats"])
     r0, _ = bench.run_record_chain_host(50_000, opt_level=OptLevel.LEVEL0)
     r2, _ = bench.run_record_chain_host(50_000, opt_level=OptLevel.LEVEL2)
     out["7_record_chain_host_unfused"] = round(r0, 1)

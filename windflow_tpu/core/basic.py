@@ -302,6 +302,16 @@ class RuntimeConfig:
     # preserving per-segment error policies / stats / faults /
     # checkpoint state.  Set LEVEL0 (or LEVEL1) to opt out.
     opt_level: "OptLevel" = OptLevel.LEVEL2
+    # whole-partition device step (graph/device_step.py; ROADMAP item
+    # 3): at LEVEL2, device-placed segments additionally lower to
+    # chunk-granular launch control -- forward edges merge into
+    # device-eligible consumers (source heads included) and every
+    # device-lane window engine launches ONCE per ingest chunk instead
+    # of per trigger site.  WINDFLOW_DEVICE_STEP=0 (or False here)
+    # opts out; a LEVEL0/LEVEL1 opt_level disables it implicitly.
+    device_step: bool = field(
+        default_factory=lambda: os.environ.get(
+            "WINDFLOW_DEVICE_STEP", "1") != "0")
     # per-graph column-buffer pool (core/tuples.ColumnPool): partition
     # sub-batches, SynthChunk materialization and ingest staging reuse
     # arena buffers instead of allocating per batch.  False = every

@@ -62,6 +62,21 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
         if tier is not None:
             entry["tier"] = tier
         hot.append(entry)
+    # per-tier keyed-state totals (schema v9 census extras): tiered
+    # stores report hot/warm/cold, device-lane window engines report
+    # their resident forest bytes under "device" (audit/census.py;
+    # windflow_keyed_state_bytes{tier=...} renders the same rows)
+    tier_tot: dict = {}
+    for row in (skew.get("Census") or []):
+        for tier, kb in (row.get("tiers") or {}).items():
+            keys, nbytes = ((int(kb[0] or 0), int(kb[1] or 0))
+                            if isinstance(kb, (list, tuple))
+                            else (0, int(kb or 0)))
+            t = tier_tot.setdefault(tier, [0, 0])
+            t[0] += keys
+            t[1] += nbytes
+    state_tiers = {t: {"keys": v[0], "bytes": v[1]}
+                   for t, v in sorted(tier_tot.items())} or None
     hist = stats.get("History") or {}
     series = hist.get("Series") or {}
     history = None
@@ -189,6 +204,7 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
         "Conservation": conservation,
         "Durability": durability,
         "Hot_keys": hot,
+        "State_tiers": state_tiers,
         "History": history,
         "Failures": failures,
         "Arbitrations": arbitrations[-FLIGHT_TAIL:],
@@ -471,6 +487,11 @@ def render_text(report: dict) -> str:
                 out.append(f"  [{e.get('t')}] {e.get('node')}: spill "
                            f"batch of {e.get('keys')} key(s) re-warmed "
                            f"-- spill disk full ({e.get('error')})")
+    tiers = report.get("State_tiers") or {}
+    if tiers:
+        out.append("keyed-state tiers: " + ", ".join(
+            f"{t}={v['keys']} key(s)/{v['bytes']}B"
+            for t, v in tiers.items()))
     hot = report.get("Hot_keys") or []
     if hot:
         out.append("hot keys: " + ", ".join(

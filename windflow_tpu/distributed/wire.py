@@ -243,8 +243,8 @@ def _trace_header(item) -> bytes:
         "age_s": round(now - ctx.t0, 9),
         "last_s": round(ctx.last - ctx.t0, 9),
         "sent_unix": _time.time(),
-        "hops": [[name, round(a - ctx.t0, 9), round(d - ctx.t0, 9)]
-                 for name, a, d in ctx.hops],
+        "hops": [[name, round(a - ctx.t0, 9), round(d - ctx.t0, 9), *rest]
+                 for name, a, d, *rest in ctx.hops],
     }
     blob = json.dumps(doc).encode("utf-8")
     if len(blob) > 0xFFFF:  # pathological hop list: ship untraced
@@ -287,8 +287,11 @@ def rebuild_trace(doc: Optional[dict], edge: str,
             name, a, d = hop[0], float(hop[1]), float(hop[2])
         except (TypeError, ValueError, IndexError):
             continue
+        meta = hop[3] if len(hop) > 3 and isinstance(hop[3], dict) else None
         if len(ctx.hops) < MAX_HOPS:
-            ctx.hops.append((str(name), ctx.t0 + a, ctx.t0 + d))
+            ctx.hops.append((str(name), ctx.t0 + a, ctx.t0 + d) if meta
+                            is None else
+                            (str(name), ctx.t0 + a, ctx.t0 + d, meta))
     ctx.hop(f"{edge}@wire", ctx.t0 + last + 1e-9, arrival)
     return ctx
 

@@ -321,6 +321,17 @@ class PipeGraph:
                     "RuntimeConfig.diagnosis at its default True)")
             from .replanner import RePlanner
             self.replanner = RePlanner(self)
+        # whole-partition device step (graph/device_step.py; ROADMAP
+        # item 3): AFTER fusion + placement (it lowers the post-fusion
+        # node set by resolved lane), BEFORE the binding loop / ingest
+        # wiring so step nodes bind like any other fused node.  Merges
+        # forward edges into device-eligible consumers (including
+        # source heads) and puts every device-lane window engine under
+        # chunk-granular launch control: one launch per ingest chunk.
+        from .device_step import lower_device_steps
+        self.step_nodes = lower_device_steps(self)
+        for name in self.step_nodes:
+            self.flight.record("device_step", node=name)
         # attach the column pool to every node and emitter (pooled
         # materialization + partition sub-batches)
         if self.buffer_pool is not None:
@@ -403,6 +414,13 @@ class PipeGraph:
                     # poisoning could unblock it (runtime/node.py
                     # SourceLoopLogic.eos_flush)
                     src.cancel_token = self._cancel
+                    # adaptive-skew watermarked bodies
+                    # (eventtime/watermarks.py skew="auto") announce
+                    # their bound revisions on the flight recorder
+                    uf = getattr(src, "user_fn", None)
+                    if getattr(uf, "_wants_flight", False):
+                        uf.flight = self.flight
+                        uf.source_name = n.name
         # tiered keyed state (state/; docs/RESILIENCE.md "Tiered state
         # & memory pressure"): under RuntimeConfig.state_budget_bytes,
         # swap capable keyed logics' dict stores for TieredKeyedStores

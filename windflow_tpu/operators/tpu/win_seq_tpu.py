@@ -218,7 +218,8 @@ class _AsyncDispatcher:
                 break
             if self.aborting or self.error is not None:
                 continue  # failed/aborted: drain the queue, launch nothing
-            engine, cols, starts, ends, gwids, descs, birth, emit = item
+            (engine, cols, starts, ends, gwids, descs, birth, emit,
+             nbytes_in) = item
             last_emit = emit
             try:
                 t_sub = _time.perf_counter()
@@ -228,7 +229,7 @@ class _AsyncDispatcher:
                     handle = engine.compute(cols, starts, ends, gwids)
                 logic.launched_batches += 1
                 pending.append((handle, descs, birth, t_sub,
-                                len(pending) + 1))
+                                len(pending) + 1, nbytes_in))
                 # flush at depth (backpressure) AND any batch whose
                 # async D2H already landed -- otherwise results wait
                 # for the pipeline to fill and latency grows with
@@ -382,6 +383,14 @@ class WinSeqTPULogic(NodeLogic):
         # gauge-grade for sampled traces, like the depth gauges
         self._trace_ctx = None
         self._trace_name = "win_seq_tpu"
+        # whole-partition device step (graph/device_step.py): while a
+        # chunk is traversing the fused chain the step logic holds all
+        # intra-chunk launch triggers and calls flush_chunk() once at
+        # the chunk boundary, so a device segment pays ONE launch per
+        # ingest chunk instead of one per trigger site.  eos_flush /
+        # quiesce / idle_tick stay unguarded -- they run between
+        # chunks, where the hold is always clear.
+        self.chunk_hold = False
         # the C++ columnar engine covers the hot standalone cases
         # (native/window_engine.cpp): builtin kinds, identity window
         # assignment, default value column, role SEQ -- or role PLQ,
@@ -619,7 +628,7 @@ class WinSeqTPULogic(NodeLogic):
         """Flush one in-flight batch: block on its handle, record the
         per-launch device time (submit -> result on host), sample the
         window-result latency, feed the adaptive batch resize, emit."""
-        handle, descs, birth, t_sub, depth = entry
+        handle, descs, birth, t_sub, depth, nbytes_in = entry
         results = handle.block()
         now = _time.perf_counter()
         launch_ms = (now - t_sub) * 1e3
@@ -658,8 +667,16 @@ class WinSeqTPULogic(NodeLogic):
             self._trace_ctx = None
             name = self._trace_name
             if self.resolved_placement != "host":
-                name += "@device"
-            tr.hop(name, t_sub, now)
+                # device-lane hops carry launch count + transfer bytes
+                # as gauge-grade hop meta so a whole-partition step
+                # (graph/device_step.py) stays attributable as ONE
+                # launch per chunk in the diagnosis plane
+                tr.hop(name + "@device", t_sub, now,
+                       meta={"launches": 1,
+                             "bytes_in": int(nbytes_in),
+                             "bytes_out": int(results.nbytes)})
+            else:
+                tr.hop(name, t_sub, now)
         self._emit_results(results, descs, emit, trace=tr)
 
     def _submit(self, cols, starts, ends, gwids, descs, birth, emit,
@@ -667,17 +684,18 @@ class WinSeqTPULogic(NodeLogic):
         """Hand one staged batch to the device: via the dispatcher
         thread (default) or inline with the waitAndFlush protocol."""
         eng = engine or self.engine
+        nbytes_in = (sum(int(np.asarray(c).nbytes) for c in cols.values())
+                     + starts.nbytes + ends.nbytes + gwids.nbytes)
         if self.stats is not None:  # single-writer: ingest thread
             self.stats.num_launches += 1
-            self.stats.bytes_to_device += (
-                sum(int(np.asarray(c).nbytes) for c in cols.values())
-                + starts.nbytes + ends.nbytes + gwids.nbytes)
+            self.stats.bytes_to_device += nbytes_in
             self.stats.inputs_ignored = self.ignored_tuples
         if self.async_dispatch:
             if self._dispatcher is None:
                 self._dispatcher = _AsyncDispatcher(self)
             self._dispatcher.submit(
-                (eng, cols, starts, ends, gwids, descs, birth, emit))
+                (eng, cols, starts, ends, gwids, descs, birth, emit,
+                 nbytes_in))
         else:
             self._flush_pending(emit)  # waitAndFlush of the previous
             t_sub = _time.perf_counter()
@@ -685,7 +703,7 @@ class WinSeqTPULogic(NodeLogic):
                 handle = eng.compute(cols, starts, ends, gwids)
             self.launched_batches += 1
             self.pending.append((handle, descs, birth, t_sub,
-                                 len(self.pending) + 1))
+                                 len(self.pending) + 1, nbytes_in))
         self._buffered_since_launch = 0
         self._last_launch_t = _time.perf_counter()
 
@@ -1035,7 +1053,8 @@ class WinSeqTPULogic(NodeLogic):
                         self._batch_birth = _time.perf_counter()
             self.descriptors.append((key, gwid, start, end, rts, key))
             st.next_fire += 1
-            if len(self.descriptors) >= self.batch_len:
+            if (len(self.descriptors) >= self.batch_len
+                    and not self.chunk_hold):
                 self._launch(emit)
 
     # -- columnar ingest (the zero-copy fast path: a whole TupleBatch is
@@ -1083,9 +1102,10 @@ class WinSeqTPULogic(NodeLogic):
         if ready and self._batch_birth is None:
             self._batch_birth = _time.perf_counter()
         self._buffered_since_launch += len(batch)
-        if ready and (ready >= self.batch_len
-                      or self._buffered_since_launch >= self.max_buffer_elems
-                      or self._launch_due()):
+        if (ready and not self.chunk_hold
+                and (ready >= self.batch_len
+                     or self._buffered_since_launch >= self.max_buffer_elems
+                     or self._launch_due())):
             self._native_launch(emit)
 
     def _svc_batch(self, batch: TupleBatch, emit):
@@ -1153,7 +1173,7 @@ class WinSeqTPULogic(NodeLogic):
             if last_w >= 0:
                 st.opened_max = max(st.opened_max, last_w)
             self._fire_ready(key, st, st.max_id, hashcode, emit)
-        if (self.descriptors
+        if (self.descriptors and not self.chunk_hold
                 and (self._buffered_since_launch >= self.max_buffer_elems
                      or self._launch_due())):
             self._launch(emit)
@@ -1176,10 +1196,11 @@ class WinSeqTPULogic(NodeLogic):
                 if ready and self._batch_birth is None:
                     self._batch_birth = _time.perf_counter()
                 self._buffered_since_launch += item.n
-                if ready and (ready >= self.batch_len
-                              or self._buffered_since_launch
-                              >= self.max_buffer_elems
-                              or self._launch_due()):
+                if (ready and not self.chunk_hold
+                        and (ready >= self.batch_len
+                             or self._buffered_since_launch
+                             >= self.max_buffer_elems
+                             or self._launch_due())):
                     self._native_launch(emit)
             else:
                 self._svc_batch(item.materialize(), emit)
@@ -1239,7 +1260,8 @@ class WinSeqTPULogic(NodeLogic):
                 st.min_new_id = id_
         st.max_id = max(st.max_id, id_)
         self._fire_ready(key, st, id_, hashcode, emit)
-        if self.descriptors and self._launch_due():
+        if (self.descriptors and self._launch_due()
+                and not self.chunk_hold):
             self._launch(emit)
 
     def eos_flush(self, emit):
@@ -1289,6 +1311,23 @@ class WinSeqTPULogic(NodeLogic):
         elif self.descriptors:
             self._launch(emit)
 
+    def flush_chunk(self, emit) -> int:
+        """Chunk-boundary launch for the whole-partition device step
+        (graph/device_step.py): everything that fired while
+        ``chunk_hold`` suppressed the intra-chunk triggers goes out as
+        ONE launch.  Returns the number of launches issued (0 or 1) so
+        the step logic can account launches-per-chunk."""
+        if self._native is not None:
+            ready = self._native.ready()
+            if ready:
+                self._native_launch(emit, max_windows=ready)
+                return 1
+            return 0
+        if self.descriptors:
+            self._launch(emit)
+            return 1
+        return 0
+
     def quiesce(self, emit) -> bool:
         """Live-checkpoint barrier hook (pipegraph.quiesce): drain every
         in-flight device batch, emitting its results, so ``state_dict``
@@ -1329,6 +1368,12 @@ class WinSeqTPULogic(NodeLogic):
                    + st.values.nbytes + 96)
         except (RuntimeError, StopIteration, AttributeError):
             per = 96  # resized under us: count-only estimate
+        res = self.device_resident_bytes()
+        if res:
+            # ROADMAP item 4: resident-forest bytes surface as the
+            # census "device" tier (metrics render them under
+            # windflow_keyed_state_bytes{tier="device"})
+            return (n, n * per, {"tiers": {"device": [n, int(res)]}})
         return (n, n * per)
 
     # -- checkpoint / resume (utils/checkpoint.py policy layer) --------

@@ -56,9 +56,17 @@ class TraceContext:
         self.hops: list = []    # (operator, t_arrive, t_done)
         self.trace_id = trace_id
 
-    def hop(self, name: str, t_in: float, t_done: float) -> None:
+    def hop(self, name: str, t_in: float, t_done: float,
+            meta: Optional[dict] = None) -> None:
+        """Record one hop stamp.  ``meta`` (optional, gauge-grade)
+        rides as a trailing dict on the serialized hop -- the device
+        engines use it to carry launch count + transfer bytes on their
+        ``@device`` hops so a whole-partition step (graph/device_step)
+        stays attributable as ONE launch per chunk.  Readers index
+        ``hop[0..2]`` and must ignore extra elements."""
         if len(self.hops) < MAX_HOPS:
-            self.hops.append((name, t_in, t_done))
+            self.hops.append((name, t_in, t_done) if meta is None
+                             else (name, t_in, t_done, meta))
         self.last = t_done
 
     def to_dict(self, t_end: float) -> dict:
@@ -67,8 +75,8 @@ class TraceContext:
             "src": self.src,
             "e2e_ms": round((t_end - t0) * 1e3, 3),
             "hops": [[name, round((a - t0) * 1e3, 3),
-                      round((d - t0) * 1e3, 3)]
-                     for name, a, d in self.hops],
+                      round((d - t0) * 1e3, 3), *rest]
+                     for name, a, d, *rest in self.hops],
         }
         if self.trace_id is not None:
             d["id"] = self.trace_id
