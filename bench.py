@@ -1120,6 +1120,164 @@ def run_multitenant_contention(n_events, n_tenants=3):
     return rate, per_tenant, identical, summary
 
 
+def _bench20_cfg():
+    """Worker-side RuntimeConfig for config #20 (importable by name:
+    fleet workers re-import this module and load it via _load_ref)."""
+    import tempfile
+    import windflow_tpu as wf
+    from windflow_tpu.elastic import ElasticityConfig
+    return wf.RuntimeConfig(
+        trace_sample=16,
+        log_dir=tempfile.gettempdir(),
+        elasticity=ElasticityConfig(enabled=False))
+
+
+def _bench20_build(g):
+    """Worker-side tenant graph for config #20.  The per-tenant event
+    count travels via the environment: the worker process imports this
+    module fresh, so closures cannot carry it over."""
+    import windflow_tpu as wf
+    n = int(os.environ.get("WINDFLOW_BENCH20_N", "4000"))
+    state = {"i": 0}
+
+    def src(shipper):
+        i = state["i"]
+        if i >= n:
+            return False
+        shipper.push(wf.BasicRecord(i % 8, i // 8, i // 8,
+                                    float(i % 101)))
+        state["i"] = i + 1
+        return True
+
+    g.add_source(wf.SourceBuilder(src).build()) \
+        .add(wf.MapBuilder(lambda t: wf.BasicRecord(
+            t.key, t.id, t.ts, t.value * 1.0001)).build()) \
+        .add_sink(wf.SinkBuilder(lambda r: None).build())
+
+
+def run_global_scheduler(n_events, n_tenants=8, n_workers=2):
+    """Config #20: the fleet-level control plane (docs/SERVING.md
+    "Global scheduler").
+
+    Part A -- placement + isolation books: ``n_tenants`` tenants are
+    placed over ``n_workers`` real worker processes by the pure
+    bin-pack policy and run to completion.  Per-tenant traced e2e
+    p50/p99 ride the owning worker's tenant rows, the policy must have
+    used every worker, and each tenant's conservation ledger must
+    balance fleet-wide.
+
+    Part B -- pay-for-what-you-use: the SAME single-tenant workload
+    runs in-process with the scheduler plane ON (fair_share=True +
+    device registry + worker identity) and OFF; the deterministic sink
+    fold must be BITWISE IDENTICAL and the scheduler-on lane must
+    record ZERO gate wait -- fleet scheduling costs nothing until a
+    second tenant contends.  Returns {"rate", "tenants",
+    "conservation", "sched_identity"}."""
+    import warnings
+    import windflow_tpu as wf
+    from windflow_tpu.elastic import ElasticityConfig
+    from windflow_tpu.scheduler import FleetServer
+    from windflow_tpu.serving import Server, TenantSpec
+
+    n_events = max(int(n_events), n_tenants * 4_000)
+    per_n = n_events // n_tenants
+
+    # -- part A: a real fleet under one placement policy ---------------
+    per_tenant = []
+    os.environ["WINDFLOW_BENCH20_N"] = str(per_n)
+    try:
+        with FleetServer(workers=n_workers,
+                         capacity=n_tenants * 4096,
+                         push_interval_s=0.2) as fleet:
+            t0 = time.perf_counter()
+            for i in range(n_tenants):
+                row = fleet.submit(f"bench20-t{i}", _bench20_build,
+                                   TenantSpec(credits=4096,
+                                              priority=i % 3),
+                                   config_fn=_bench20_cfg)
+                assert row["State"] == "PLACED", row
+            placements = fleet.stats()["Placements"]
+            rows = [fleet.wait(f"bench20-t{i}", timeout=600.0)
+                    for i in range(n_tenants)]
+            dt = time.perf_counter() - t0
+    finally:
+        os.environ.pop("WINDFLOW_BENCH20_N", None)
+    workers_used = {p["Worker"] for p in placements}
+    assert len(workers_used) == n_workers, \
+        f"policy left workers idle: {sorted(workers_used)}"
+    conservation = True
+    for row in rows:
+        assert row["State"] == "COMPLETED", row
+        cons = row.get("Conservation") or {}
+        if cons and not cons.get("Edges_balanced"):
+            conservation = False
+        e2e = row.get("Latency_e2e") or {}
+        per_tenant.append({
+            "tenant": row["Tenant"],
+            "records": per_n,
+            "rate": round(per_n / dt, 1),
+            "p50_ms": round((e2e.get("p50_us") or 0) / 1e3, 3),
+            "p99_ms": round((e2e.get("p99_us") or 0) / 1e3, 3),
+        })
+    rate = n_tenants * per_n / dt
+
+    # -- part B: scheduler on/off A/B, one tenant, in-process ----------
+    def one(scheduled):
+        acc = {"n": 0, "sum": 0.0}
+
+        def build(g):
+            state = {"i": 0}
+
+            def src(shipper):
+                i = state["i"]
+                if i >= per_n:
+                    return False
+                shipper.push(wf.BasicRecord(i % 8, i // 8, i // 8,
+                                            float(i % 101)))
+                state["i"] = i + 1
+                return True
+
+            def sink(r):
+                if r is not None:
+                    acc["n"] += 1
+                    acc["sum"] += r.value
+
+            g.add_source(wf.SourceBuilder(src).build()) \
+                .add(wf.MapBuilder(lambda t: wf.BasicRecord(
+                    t.key, t.id, t.ts, t.value * 1.0001)).build()) \
+                .add_sink(wf.SinkBuilder(sink).build())
+
+        extra = ({"fair_share": True, "devices": 1, "worker_id": 0}
+                 if scheduled else {})
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            srv = Server(capacity=1 << 14, arbiter=False, **extra)
+            try:
+                h = srv.submit("bench20-ab", build,
+                               TenantSpec(credits=4096),
+                               config=wf.RuntimeConfig(
+                                   trace_sample=16,
+                                   elasticity=ElasticityConfig(
+                                       enabled=False)))
+                assert h.wait(600) == "COMPLETED", h.error
+                wait_s = srv.scheduler_block()["Sched_wait_s"] \
+                    if scheduled else None
+            finally:
+                srv.close()
+        return acc, wait_s
+
+    acc_on, wait_on = one(True)
+    acc_off, _ = one(False)
+    sched_identity = acc_on == acc_off
+    assert sched_identity, ("scheduler-on single-tenant run diverged",
+                            acc_on, acc_off)
+    assert wait_on == 0.0, \
+        f"solo tenant waited in the fair-share gate: {wait_on}s"
+    return {"rate": round(rate, 1), "tenants": per_tenant,
+            "conservation": conservation,
+            "sched_identity": sched_identity}
+
+
 def run_checkpoint_overhead(n_events, interval_s=1.0):
     """Config #11: the durability-plane overhead gate
     (docs/RESILIENCE.md "Exactly-once epochs").  The identical 2f-style
@@ -2434,6 +2592,13 @@ def main():
     configs["19_device_step"] = {
         **r19, "rate": r19["step"]["rate"],
         "window_latency_p50_ms": p50s, "window_latency_p99_ms": p99s}
+    # fleet-level control plane (scheduler/; docs/SERVING.md "Global
+    # scheduler"): 8 tenants over 2 real worker processes, per-tenant
+    # p99 from the owning worker's rows, conservation fleet-wide, plus
+    # the scheduler-on/off single-tenant bitwise-identity proof
+    r20 = run_global_scheduler(N_EVENTS // 32)
+    configs["20_global_scheduler"] = {
+        **r20, "records": sum(t["records"] for t in r20["tenants"])}
     for name, c in configs.items():
         n_out = c.get("windows", c.get("records", 0))
         print(f"[bench] {name}: {c['rate']:,.0f} tuples/s "

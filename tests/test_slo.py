@@ -700,7 +700,8 @@ GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
 # these keys (plus Source added by the CLI) for ANY input dump
 REPORT_KEYS = {
     "Graph", "Schema_version", "Verdict", "Bottleneck", "Attribution",
-    "Anomalies", "Anomalies_total", "Slo", "Conservation",
+    "Anomalies", "Anomalies_total", "Slo", "Scheduler",
+    "Scheduler_events", "Conservation",
     "Durability", "Hot_keys", "State_tiers", "History", "Failures",
     "Arbitrations",
     "Replacements", "Replica_restarts", "Recovery_fallbacks",

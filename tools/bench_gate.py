@@ -175,6 +175,22 @@ def measure() -> tuple:
     out["19_device_step"] = r19["step"]["rate"]
     out["19_plain_fused"] = r19["plain"]["rate"]
     lats["19_device_step"] = _pcts_ms(r19["lats"])
+    # fleet control-plane smoke (scheduler/; docs/SERVING.md "Global
+    # scheduler"): 8 tenants over 2 real worker processes; the helper
+    # itself asserts every worker hosted tenants, all ledgers balanced
+    # fleet-wide, and the scheduler-on/off single-tenant A/B bitwise
+    # identical with zero gate wait (pay-for-what-you-use), so the
+    # gated rate mostly catches a wedged placement/fair-share plane.
+    # Per-tenant p99 rides the latency gate (worst qualified tenant,
+    # config-14 discipline: both stats from the same tenant set).
+    r20 = bench.run_global_scheduler(N_SMALL // 4)
+    assert r20["conservation"], "fleet tenants failed conservation"
+    assert r20["sched_identity"], "scheduler-on single-tenant diverged"
+    out["20_global_scheduler"] = r20["rate"]
+    qual20 = [t for t in r20["tenants"] if t.get("p99_ms")]
+    lats["20_global_scheduler"] = (
+        {"p50_ms": max(t.get("p50_ms") or 0 for t in qual20),
+         "p99_ms": max(t["p99_ms"] for t in qual20)} if qual20 else None)
     r0, _ = bench.run_record_chain_host(50_000, opt_level=OptLevel.LEVEL0)
     r2, _ = bench.run_record_chain_host(50_000, opt_level=OptLevel.LEVEL2)
     out["7_record_chain_host_unfused"] = round(r0, 1)

@@ -441,3 +441,10 @@ class RuntimeConfig:
     # shuffle transport.  None (the default) = single-process graph;
     # normally set by the worker entry point, not by hand.
     distributed: Any = None
+    # -- global-scheduler plane (scheduler/; docs/SERVING.md) -----------
+    # a scheduler.leases.FairShareLease gating this graph's consume
+    # loops so co-resident tenants in one worker share cores by
+    # weighted credit instead of the OS scheduler.  Bound to every
+    # runtime node at start; a lease-less graph (the default) pays
+    # nothing.  Normally set by a fair-share Server, not by hand.
+    sched_lease: Any = None

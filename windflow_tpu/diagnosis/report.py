@@ -177,6 +177,35 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
         "error": e.get("error"),
     } for e in flight if e.get("kind") == "epoch_abort"
         and e.get("reason") == "disk_full"]
+    # scheduler plane (scheduler/; docs/SERVING.md "Global
+    # scheduler"): the worker's placement/lease block plus every
+    # fleet-level decision in flight -- placements, crash re-placings,
+    # structured rejections, worker deaths -- so the doctor explains
+    # WHY a tenant sits where it does (or was refused)
+    sched_blk = stats.get("Scheduler")
+    scheduler = None
+    if sched_blk:
+        dev = sched_blk.get("Devices") or {}
+        scheduler = {
+            "Worker": sched_blk.get("Worker"),
+            "Fair_share": bool(sched_blk.get("Fair_share")),
+            "Sched_wait_s": float(sched_blk.get("Sched_wait_s", 0)
+                                  or 0.0),
+            "Placements": list(sched_blk.get("Placements") or ()),
+            "Device_contended": bool(dev.get("Contended")),
+            "Device_holders": int(dev.get("Holders", 0) or 0),
+        }
+    sched_events = [{
+        "t": e.get("t"),
+        "kind": e.get("kind"),
+        "tenant": e.get("tenant"),
+        "worker": e.get("worker"),
+        "operators": e.get("operators"),
+        "reason": e.get("reason"),
+        "hint": e.get("hint"),
+    } for e in flight if e.get("kind") in (
+        "sched_place", "sched_replace", "sched_rejected",
+        "worker_death")]
     dur = stats.get("Durability")
     durability = None
     if dur:
@@ -201,6 +230,8 @@ def build_report(stats: dict, flight: Optional[list] = None) -> dict:
         "Anomalies": anomalies,
         "Anomalies_total": diag.get("Anomalies_total", len(anomalies)),
         "Slo": slo,
+        "Scheduler": scheduler,
+        "Scheduler_events": sched_events[-FLIGHT_TAIL:],
         "Conservation": conservation,
         "Durability": durability,
         "Hot_keys": hot,
@@ -278,6 +309,21 @@ def _verdict(report: dict) -> str:
     if fb:
         parts.append(f"recovery fell back past {len(fb)} unreadable "
                      f"snapshot(s) ({fb[-1].get('reason')})")
+    sched_ev = report.get("Scheduler_events") or []
+    deaths = [e for e in sched_ev if e.get("kind") == "worker_death"]
+    if deaths:
+        replaced = [e for e in sched_ev
+                    if e.get("kind") == "sched_replace"]
+        parts.append(f"worker {deaths[-1].get('worker')} DIED "
+                     f"({len(replaced)} tenant(s) re-placed)")
+    rejected = [e for e in sched_ev
+                if e.get("kind") == "sched_rejected"]
+    if rejected:
+        last = rejected[-1]
+        what = last.get("tenant") or last.get("operators")
+        parts.append(f"scheduler REJECTED {what}"
+                     + (f" ({last['reason']})"
+                        if last.get("reason") else ""))
     bn = report["Bottleneck"] or {}
     if bn.get("Operator"):
         if bn.get("Verdict") == "input_bound":
@@ -420,6 +466,33 @@ def render_text(report: dict) -> str:
                    + (f" delta_commit_bytes="
                       f"{dur.get('Last_commit_bytes')}"
                       if dur.get("Delta") else ""))
+    sched = report.get("Scheduler")
+    sched_ev = report.get("Scheduler_events") or []
+    if sched or sched_ev:
+        out.append("")
+        if sched:
+            out.append(
+                f"scheduler: worker={sched.get('Worker')} "
+                f"fair_share={sched.get('Fair_share')} "
+                f"sched_wait={sched.get('Sched_wait_s', 0):.3f}s "
+                f"placements={len(sched.get('Placements') or ())}"
+                + (f"  chip CONTENDED "
+                   f"({sched.get('Device_holders')} holders)"
+                   if sched.get("Device_contended") else ""))
+            for p in sched.get("Placements") or ():
+                out.append(f"  tenant {p.get('Tenant')} @ worker "
+                           f"{p.get('Worker')}: {p.get('State')} "
+                           f"credits={p.get('Credits')} "
+                           f"prio={p.get('Priority')} "
+                           f"weight={p.get('Weight')} "
+                           f"devices={p.get('Devices')}")
+        for e in sched_ev:
+            fields = " ".join(
+                f"{k}={v}" for k, v in e.items()
+                if k not in ("t", "kind", "hint") and v is not None)
+            out.append(f"  [{e.get('t')}] {e.get('kind')} {fields}")
+            if e.get("hint"):
+                out.append(f"    hint: {e['hint']}")
     arbs = report.get("Arbitrations") or []
     if arbs:
         out.append("")

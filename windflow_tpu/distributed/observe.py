@@ -227,6 +227,7 @@ def merge_stats(stats_list: List[dict], live: bool = False) -> dict:
     workers: List[dict] = []
     slo_blocks: List[dict] = []
     pool_blocks: List[dict] = []
+    sched_blocks: List[dict] = []
     flight_seen = set()
     for stats in stats_list:
         w = stats.get("Worker")
@@ -266,6 +267,11 @@ def merge_stats(stats_list: List[dict], live: bool = False) -> dict:
             slo_blocks.append(stats["Slo"])
         if stats.get("Pool"):
             pool_blocks.append(stats["Pool"])
+        sched = stats.get("Scheduler")
+        if isinstance(sched, dict):
+            sched = dict(sched)
+            sched.setdefault("Worker", w)
+            sched_blocks.append(sched)
         for k in sums:
             sums[k] += int(stats.get(k, 0) or 0)
         cons = stats.get("Conservation")
@@ -365,6 +371,17 @@ def merge_stats(stats_list: List[dict], live: bool = False) -> dict:
             "Bytes": sum(int(p.get("Bytes", 0) or 0)
                          for p in pool_blocks),
         } if pool_blocks else None),
+        # scheduler plane (scheduler/): per-worker blocks kept whole
+        # (placement is per-worker truth, never re-derived here) plus
+        # the two fleet-level aggregates readers actually chart
+        "Scheduler": ({
+            "Workers": sched_blocks,
+            "Sched_wait_s": round(sum(
+                float(b.get("Sched_wait_s", 0) or 0)
+                for b in sched_blocks), 3),
+            "Placements": [row for b in sched_blocks
+                           for row in (b.get("Placements") or ())],
+        } if sched_blocks else None),
     }
     merged.update(sums)
     return merged

@@ -112,10 +112,30 @@ def distribute_graph(graph) -> DistRuntime:
     plan = graph._dist_plan
     me = int(spec.worker_id)
     if graph.elastic:
-        raise RuntimeError(
-            "distributed runtime: elastic operators are not supported "
-            "across workers yet (docs/DISTRIBUTED.md); remove "
-            ".with_elasticity or pin the graph to one worker")
+        # structured rejection (scheduler/errors.py): name the elastic
+        # operators, the worker that owns them under the plan, and the
+        # fleet-level path that DOES support elasticity -- plus a
+        # sched_rejected flight event so doctor explains the refusal
+        # instead of a bare traceback (ISSUE 20 satellite).
+        from ..scheduler.errors import SchedulerError
+        ops = sorted(graph.elastic)
+        owners = sorted({node_owner(n, plan)
+                         for n in graph._all_nodes()
+                         if n.elastic_group in graph.elastic})
+        owner = owners[0] if len(owners) == 1 else None
+        hint = ("run the tenant under scheduler.FleetServer: the "
+                "fleet places it WHOLE onto one worker, where rescale "
+                "and the arbiter's elastic squeezes work unchanged "
+                "(docs/SERVING.md 'Global scheduler')")
+        graph.flight.record(
+            "sched_rejected", operators=ops, worker=owner,
+            workers=owners, path="scheduler.FleetServer", hint=hint)
+        raise SchedulerError(
+            f"distributed runtime: elastic operators {ops} are not "
+            f"supported across workers (owned by worker"
+            f"{'s' if len(owners) != 1 else ''} {owners}; "
+            f"docs/DISTRIBUTED.md); {hint}",
+            worker=owner, operators=ops, hint=hint)
     nodes = graph._all_nodes()
     owners = {id(n): node_owner(n, plan) for n in nodes}
     consumer = {}

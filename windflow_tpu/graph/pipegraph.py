@@ -348,9 +348,16 @@ class PipeGraph:
         wire_ingest(self)
         fault_plan = getattr(self.config, "fault_plan", None)
         hub = self.telemetry
+        # global-scheduler plane (scheduler/leases.py): the tenant's
+        # fair-share lease gates every consume loop and unblocks on
+        # cancel like any registered channel (it exposes poison())
+        sched_lease = getattr(self.config, "sched_lease", None)
+        if sched_lease is not None:
+            self._cancel.register(sched_lease)
         for n in self._all_nodes():
             n.pause_ctl = self._pause_ctl
             n.cancel_token = self._cancel
+            n.sched_lease = sched_lease
             n.dead_letters = self.dead_letters
             # telemetry plane: every node/logic learns the flight
             # recorder; under active tracing sampling the hub is bound

@@ -43,10 +43,16 @@ from ..telemetry.histogram import LogHistogram
 # misses quarantined to dead letters), Sessions_open (live gap
 # sessions) and Join_state_keys (keys with buffered join state) --
 # emitted only when nonzero.
+# 11 = adds the optional Scheduler block (global-scheduler plane,
+# scheduler/: tenant->worker placement, fair-share leases, device
+# leases -- serving/server.py publishes it per tenant graph when the
+# plane is on) and replica records may carry Sched_wait_s (seconds a
+# consume loop spent gated by the fair-share lease; emitted only when
+# nonzero).
 # Readers (doctor CLI, dashboard /explain, tests) must tolerate MISSING
 # blocks rather than dispatch on this number: older dumps carry no
 # version field at all, and every block is optional by contract.
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 
 
 @dataclass
@@ -114,6 +120,11 @@ class StatsRecord:
     # elastic signal plane (elastic/signals.py)
     queue_depth: int = 0
     credit_wait_s: float = 0.0
+    # cumulative seconds this replica's consume loop spent blocked in
+    # the worker's fair-share gate (scheduler/leases.py) -- lets the
+    # diagnosis plane name SCHEDULING, not queueing or credits, as the
+    # bottleneck.  Zero (and not emitted) when the plane is off.
+    sched_wait_s: float = 0.0
     # peak inbound-channel depth, measured by both channel planes since
     # PR 1 (runtime/queues.py:73 / native.py:209) and exported here
     queue_high_watermark: int = 0
@@ -182,6 +193,10 @@ class StatsRecord:
             "Frontier": round(self.frontier, 1),
             "Frontier_lag_ms": round(self.frontier_lag_ms, 1),
         }
+        if self.sched_wait_s:
+            # fair-share gate wait (scheduler/leases.py): nonzero only
+            # when co-resident tenants actually contended
+            d["Sched_wait_s"] = round(self.sched_wait_s, 3)
         if self.device_state_bytes:
             d["Device_state_bytes_resident"] = self.device_state_bytes
         # event-time plane gauges: nonzero only on eventtime/ replicas
@@ -301,6 +316,10 @@ class GraphStats:
         # priority/weight standing, live credit lease, arbitration
         # count; None outside a served run
         self.tenant: Optional[dict] = None
+        # global-scheduler plane (scheduler/; docs/SERVING.md "Global
+        # scheduler"): which worker hosts this tenant, its fair-share
+        # weight, its device leases; None when the plane is off
+        self.scheduler: Optional[dict] = None
 
     def register(self, operator_name: str, replica_id: str) -> StatsRecord:
         rec = StatsRecord(operator_name, replica_id)
@@ -402,6 +421,12 @@ class GraphStats:
         with self.lock:
             self.tenant = block
 
+    def set_scheduler(self, block: Optional[dict]) -> None:
+        """Publish the global-scheduler plane's placement/lease block
+        (serving/server.py, after start and on every lease change)."""
+        with self.lock:
+            self.scheduler = block
+
     def to_json(self, dropped_tuples: int = 0,
                 dead_letter_tuples: int = 0,
                 flight_events: Optional[List[dict]] = None) -> str:
@@ -444,6 +469,7 @@ class GraphStats:
             slo = self.slo
             pool = self.pool
             tenant = self.tenant
+            scheduler = self.scheduler
             latency_e2e = None
             trace_records: List[dict] = []
             if self.histograms:
@@ -532,6 +558,10 @@ class GraphStats:
             # identity + live lease under a multi-tenant Server; None
             # outside a served run
             "Tenant": tenant,
+            # global-scheduler plane (scheduler/; docs/SERVING.md
+            # "Global scheduler"): hosting worker, fair-share weight,
+            # device leases; None when the plane is off
+            "Scheduler": scheduler,
             "Memory_usage_KB": get_mem_usage_kb(),
             "Operator_number": len(ops),
             "Operators": ops,

@@ -728,6 +728,10 @@ class RtNode(threading.Thread):
         self.faults = None
         # per-graph ColumnPool (attached at start; None = allocate fresh)
         self.pool = None
+        # global-scheduler plane (scheduler/leases.py): the tenant's
+        # fair-share lease, bound by PipeGraph.start from
+        # RuntimeConfig.sched_lease.  None (the default) = ungated.
+        self.sched_lease = None
         # sampled service-time observation: stride 1 for the first 64
         # samples, then 1/16 -- tracing must not cost a perf_counter
         # pair per tuple on the hot path
@@ -1040,6 +1044,10 @@ class RtNode(threading.Thread):
         self._wm_hook = getattr(self.logic, "on_watermark", None)
         self._accepts_chunks = accepts_chunks
         self._sync_emit = sync_emit
+        # fair-share gate resolved once per thread: a lease-less graph
+        # (the default) pays a single None check per batch
+        lease = self.sched_lease
+        stats = self.stats
         timeout = 0.025 if tick else None
         while True:
             if get_many is not None:
@@ -1055,6 +1063,13 @@ class RtNode(threading.Thread):
                 continue
             if got is None:
                 break
+            if lease is not None:
+                # weighted fair share across co-resident tenants:
+                # charge the batch, block while over-share (solo
+                # tenants never wait -- scheduler/leases.py)
+                waited = lease.acquire(len(got))
+                if waited and stats is not None:
+                    stats.sched_wait_s += waited
             if buffered and len(got) > 1:
                 self._svc_batch(got, accepts_chunks, faults, pool)
                 continue

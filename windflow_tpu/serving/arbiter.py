@@ -94,6 +94,10 @@ class TenantView:
     # (recorded as evidence -- a donor that is itself saturated gets
     # named in the decision, helping post-mortems)
     bottleneck: float = 0.0
+    # device-lease rows from the worker's DeviceLeaseRegistry
+    # ({"Operator", "Chip", "Contended", "Resident", …}); empty when
+    # the server schedules no device lanes
+    device_ops: List[dict] = field(default_factory=list)
     # the live TenantHandle this view was taken from (ignored by the
     # pure planners; the arbiter actuates through it so an evict +
     # same-name resubmit after the snapshot can never be squeezed as
@@ -142,6 +146,24 @@ def _scalable_op(v: TenantView) -> Optional[Tuple[str, int, int]]:
     return op, par, new
 
 
+def _contended_demotion(victim: TenantView,
+                        donor: TenantView) -> Optional[dict]:
+    """The device rung of the escalation ladder: when the victim holds
+    a lease on a CONTENDED chip and the donor holds a demotable
+    (non-resident) lease on the same chip, flipping the donor's lane
+    device->host frees the chip for the breaching tenant -- the
+    targeted fix, tried before any rescale/credit squeeze."""
+    victim_chips = {r.get("Chip") for r in victim.device_ops
+                    if r.get("Contended")}
+    if not victim_chips:
+        return None
+    for r in donor.device_ops:
+        if r.get("Chip") in victim_chips and not r.get("Resident"):
+            return {"type": "device", "operator": r["Operator"],
+                    "chip": r.get("Chip"), "to": "host"}
+    return None
+
+
 def plan_arbitration(views: List[TenantView], cfg: ArbiterConfig,
                      breach_runs: Dict[str, int],
                      cooldowns: Dict[str, float],
@@ -162,6 +184,32 @@ def plan_arbitration(views: List[TenantView], cfg: ArbiterConfig,
                   and d.priority <= victim.priority
                   and now >= cooldowns.get(d.name, 0.0)]
         donors.sort(key=lambda d: (d.priority, d.weight, d.name))
+        # rung 1 of the ladder: a chip-targeted device demotion.  When
+        # the victim's chip is contended, squeezing an unrelated
+        # donor's credits cannot clear the contention -- sweep for a
+        # co-lessee first (cheapest donor order still applies).
+        for donor in donors:
+            demote = _contended_demotion(victim, donor)
+            if demote is None:
+                continue
+            return {
+                "victim": victim.name,
+                "donor": donor.name,
+                "actions": [demote],
+                "evidence": {
+                    "violating": list(victim.violating),
+                    "burn_fast": victim.burn_fast,
+                    "budget_burned": victim.budget_burned,
+                    "values": dict(victim.values),
+                    "victim_priority": victim.priority,
+                    "donor_priority": donor.priority,
+                    "donor_weight": donor.weight,
+                    "donor_bottleneck": round(donor.bottleneck, 3),
+                    "chip": demote["chip"],
+                    "contended": True,
+                },
+            }
+        # rungs 2+3: elastic down-scale, then credit transfer
         for donor in donors:
             actions = []
             rescale = _scalable_op(donor)
@@ -228,6 +276,9 @@ def describe_actions(actions: List[dict], donor: str,
                 parts.append(f"returned {a['moved']} credits to {donor}")
             else:
                 parts.append(f"granted {a['moved']} credits to {victim}")
+        elif a["type"] == "device":
+            parts.append(f"demoted {a['operator']}@{donor} "
+                         f"device→host on contended {a['chip']}")
     return ", ".join(parts) if parts else "no-op"
 
 
@@ -375,6 +426,15 @@ class CrossTenantArbiter(threading.Thread):
                             now + self.cfg.cooldown_s
                         for a in decision["actions"]:
                             if a.get("applied") is False:
+                                continue
+                            if a["type"] == "device":
+                                # device demotions are ONE-WAY: a
+                                # restitution that promoted the lane
+                                # back host->device would re-contend
+                                # the chip the moment the victim
+                                # recovers (flap by construction).
+                                # Re-promotion is an operator decision
+                                # via replace_lane(op, "device").
                                 continue
                             self.donations.append(Donation(
                                 victim=decision["victim"],

@@ -141,7 +141,10 @@ class Server:
     def __init__(self, capacity: int = 1 << 20, *,
                  name: str = "windflow-server",
                  arbiter=None, dashboard: bool = True,
-                 http_port: Optional[int] = None):
+                 http_port: Optional[int] = None,
+                 fair_share: bool = False,
+                 devices=None,
+                 worker_id: Optional[int] = None):
         if capacity < 1:
             raise ValueError("Server capacity must be >= 1")
         self.name = name
@@ -150,6 +153,19 @@ class Server:
         self._lock = threading.RLock()
         self._tenants: Dict[str, TenantHandle] = {}
         self._closed = False
+        # global-scheduler plane (windflow_tpu/scheduler/): both knobs
+        # default OFF so a plain Server behaves exactly as before --
+        # the FleetServer's workers turn them on.
+        self.worker_id = worker_id
+        self.shares = None
+        if fair_share:
+            from ..scheduler.leases import FairShareRegistry
+            self.shares = FairShareRegistry()
+        self.devices = None
+        if devices is not None:
+            from ..scheduler.devices import DeviceLeaseRegistry
+            self.devices = DeviceLeaseRegistry(lanes=devices) \
+                if isinstance(devices, int) else devices
         from ..telemetry import FlightRecorder
         self.flight = FlightRecorder(512)
         # shared monitoring plane: every tenant's MonitoringThread
@@ -271,7 +287,17 @@ class Server:
             from ..slo import SloConfig
             cfg.slo = SloConfig(**spec.slo) \
                 if isinstance(spec.slo, dict) else spec.slo
+        if self.shares is not None:
+            # the tenant's consume loops share cores by weighted
+            # credit, not by the OS scheduler (scheduler/leases.py)
+            cfg.sched_lease = self.shares.lease(name, spec.weight)
         g = PipeGraph(name, Mode.DEFAULT, cfg)
+        if self.devices is not None:
+            # the planner consults the worker's device-lease registry
+            # before resolving 'device' (graph/planner.py)
+            g.device_leases = self.devices
+            g.tenant_name = name
+            g.tenant_priority = spec.priority
         if spec.pool_buffers is not None and g.buffer_pool is not None:
             from ..core.tuples import ColumnPool
             g.buffer_pool = ColumnPool(max_per_bucket=spec.pool_buffers)
@@ -289,6 +315,7 @@ class Server:
                 pass
             raise
         self._collect_ingest(handle)
+        self._set_scheduler_block(handle)   # after plan: leases exist
         return handle
 
     # -- resource plumbing ---------------------------------------------
@@ -351,11 +378,34 @@ class Server:
             **handle.spec.block(),
         })
 
+    def _set_scheduler_block(self, handle: TenantHandle) -> None:
+        """The per-graph ``Scheduler`` stats block (schema v11): only
+        published when some scheduler feature is on -- a plain
+        Server's tenants keep the block None."""
+        if self.shares is None and self.devices is None \
+                and self.worker_id is None:
+            return
+        blk = {"Tenant": handle.name, "Worker": self.worker_id,
+               "Fair_share": self.shares is not None}
+        if self.shares is not None:
+            blk["Weight"] = handle.spec.weight
+        if self.devices is not None:
+            blk["Device_leases"] = self.devices.tenant_rows(handle.name)
+        handle.graph.stats.set_scheduler(blk)
+
     def _on_tenant_end(self, handle: TenantHandle) -> None:
         """Watcher callback at the tenant's terminal state: return its
         credit reservation to the cap and publish the final block."""
         with self._lock:
             self._granted -= handle.credits
+        if self.shares is not None:
+            # drop the lease so survivors' fair-share floor no longer
+            # counts this tenant (activity expiry would also age it
+            # out, but release is immediate and exact)
+            self.shares.release(handle.name)
+        if self.devices is not None:
+            self.devices.release(handle.name)
+        self._set_scheduler_block(handle)
         self._set_tenant_block(handle)
         self.flight.record("tenant_end", tenant=handle.name,
                            state=handle.state,
@@ -430,6 +480,8 @@ class Server:
                 min_credits=handle.spec.min_credits,
                 elastic=elastic,
                 bottleneck=max(scores.values(), default=0.0),
+                device_ops=self.devices.tenant_rows(handle.name)
+                if self.devices is not None else [],
                 handle=handle,
             ))
         return views
@@ -506,6 +558,28 @@ class Server:
                     applied_any = True
                 else:
                     a["applied"] = False
+            elif a["type"] == "device":
+                # the contended-chip rung: flip the donor's lane
+                # device->host through the quiesce path (zero lost
+                # tuples) and release its chip lease so the victim
+                # stops sharing the device
+                try:
+                    donor.graph.replace_lane(
+                        a["operator"], "host",
+                        trigger=f"arbiter:device->host"
+                                f" for {victim.name}",
+                        timeout=cfg.rescale_timeout_s,
+                        evidence=decision.get("evidence") or None)
+                    if self.devices is not None:
+                        self.devices.release(donor.name,
+                                             a["operator"])
+                        self._set_scheduler_block(donor)
+                        self._set_scheduler_block(victim)
+                    a["applied"] = True
+                    applied_any = True
+                except Exception as exc:
+                    a["applied"] = False
+                    a["error"] = repr(exc)
         if applied_any:
             applied = [a for a in decision["actions"]
                        if a.get("applied")]
@@ -602,6 +676,39 @@ class Server:
         return bool(applied)
 
     # -- observability -------------------------------------------------
+    def scheduler_block(self) -> Optional[dict]:
+        """Worker-level ``Scheduler`` block (None when the scheduler
+        plane is off): capacity envelope, per-tenant placements, fair
+        -share leases with their accumulated waits, device leases.
+        Fleet workers push this to the ClusterObserver so the policy
+        re-reads live load, and ``merge_stats`` folds it fleet-wide."""
+        if self.shares is None and self.devices is None \
+                and self.worker_id is None:
+            return None
+        placements = []
+        for handle in self.tenants().values():
+            placements.append({
+                "Tenant": handle.name,
+                "Worker": self.worker_id,
+                "State": handle.state,
+                "Credits": handle.credits,
+                "Priority": handle.spec.priority,
+                "Weight": handle.spec.weight,
+                "Devices": handle.spec.devices,
+            })
+        blk = {
+            "Worker": self.worker_id,
+            "Capacity": self.capacity,
+            "Granted": self.granted,
+            "Fair_share": self.shares is not None,
+            "Placements": placements,
+        }
+        if self.shares is not None:
+            blk.update(self.shares.block())
+        if self.devices is not None:
+            blk["Devices"] = self.devices.block()
+        return blk
+
     def stats(self) -> dict:
         """The server-level ``Tenants`` block: one row per registered
         tenant with its standing, lease, state, last SLO judgement and
@@ -623,7 +730,7 @@ class Server:
                 "Error": repr(handle.error)
                 if handle.error is not None else None,
             })
-        return {
+        out = {
             "Server": self.name,
             "Capacity": self.capacity,
             "Granted": self.granted,
@@ -633,6 +740,10 @@ class Server:
                 if self.arbiter is not None else 0,
             "Tenants": rows,
         }
+        sched = self.scheduler_block()
+        if sched is not None:
+            out["Scheduler"] = sched
+        return out
 
     def stats_json(self) -> str:
         return json.dumps(self.stats())

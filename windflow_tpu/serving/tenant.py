@@ -69,6 +69,12 @@ class TenantSpec:
     * ``pool_buffers``  -- per-(dtype, bucket) ColumnPool arena bound
                            (``max_per_bucket``); None keeps the library
                            default.
+    * ``devices``       -- declared device-lane demand, an input to the
+                           fleet scheduler's placement policy (the
+                           planner still resolves actual lanes; this
+                           only steers which WORKER hosts the tenant so
+                           device-hungry tenants spread before they
+                           contend).
     """
 
     credits: int = DEFAULT_TENANT_CREDITS
@@ -78,6 +84,7 @@ class TenantSpec:
     slo: Any = None
     min_credits: int = 256
     pool_buffers: Optional[int] = None
+    devices: int = 0
 
     def __post_init__(self):
         if self.credits < 1:
@@ -89,6 +96,8 @@ class TenantSpec:
                 "TenantSpec.min_credits must be in [1, credits]")
         if self.pool_buffers is not None and self.pool_buffers < 1:
             raise ValueError("TenantSpec.pool_buffers must be >= 1")
+        if self.devices < 0:
+            raise ValueError("TenantSpec.devices must be >= 0")
 
     def block(self) -> dict:
         """The static half of the stats-JSON ``Tenant`` block (the
@@ -99,4 +108,5 @@ class TenantSpec:
             "Weight": self.weight,
             "Donor": self.donor,
             "Min_credits": self.min_credits,
+            "Devices": self.devices,
         }

@@ -369,6 +369,54 @@ def render_openmetrics(apps: dict) -> str:
     for t, lab in per_tenant():
         out.append(f"windflow_tenant_arbitrations_total{_labels(**lab)} "
                    f"{int(t.get('Arbitrations', 0) or 0)}")
+    # scheduler plane (scheduler/; docs/SERVING.md "Global
+    # scheduler"): fair-share gate waits, fleet placement identity and
+    # device leases -- absent entirely when no worker runs the plane
+    family("windflow_sched_wait_seconds", "counter",
+           "time consume loops spent blocked in the fair-share gate")
+    for _op, reps, lab in per_op():
+        waited = sum(float(r.get("Sched_wait_s", 0) or 0) for r in reps)
+        if any("Sched_wait_s" in r for r in reps):
+            out.append(f"windflow_sched_wait_seconds_total"
+                       f"{_labels(**lab)} {round(waited, 3)}")
+
+    def sched_placements():
+        for rep, lab in per_graph():
+            sched = rep.get("Scheduler")
+            if not sched:
+                continue
+            # worker-local block carries its own Placements; a merged
+            # fleet view concatenates them under the same key
+            for row in sched.get("Placements") or ():
+                yield row, lab
+
+    family("windflow_tenant_worker", "gauge",
+           "1 for the worker currently hosting the tenant "
+           "(fleet placement identity)")
+    for row, lab in sched_placements():
+        out.append(
+            f"windflow_tenant_worker"
+            f"{_labels(**lab, tenant=row.get('Tenant', ''), worker=row.get('Worker', ''))}"
+            f" 1")
+    family("windflow_device_lease", "gauge",
+           "device-lane leases held by the tenant on the worker's chip")
+    lease_counts: dict = {}
+    for rep, lab in per_graph():
+        sched = rep.get("Scheduler")
+        if not sched:
+            continue
+        blocks = [sched.get("Devices")] if sched.get("Devices") \
+            else [b.get("Devices") for b in sched.get("Workers") or ()
+                  if isinstance(b, dict) and b.get("Devices")]
+        for dev in blocks:
+            for row in dev.get("Leases") or ():
+                key = (tuple(sorted(lab.items())),
+                       row.get("Tenant", ""))
+                lease_counts[key] = lease_counts.get(key, 0) + 1
+    for (lab_items, tenant), n in sorted(lease_counts.items(),
+                                         key=lambda kv: kv[0]):
+        out.append(f"windflow_device_lease"
+                   f"{_labels(**dict(lab_items), tenant=tenant)} {n}")
     # ColumnPool arena occupancy (memory-pressure evidence next to
     # windflow_memory_bytes)
     family("windflow_pool_bytes", "gauge",
